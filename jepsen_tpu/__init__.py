@@ -1,0 +1,15 @@
+"""jepsen_tpu: a TPU-native distributed-systems safety-testing framework.
+
+A Python control plane drives a database cluster with purely functional
+operation generators, injects faults, and records an append-only operation
+history; a JAX/XLA/Pallas analysis plane checks those histories for
+consistency violations on TPU.
+
+Capability reference: seanpm2001/jepsen (jepsen-io/jepsen v0.3.6-SNAPSHOT);
+see SURVEY.md at the repo root for the structural map this build follows.
+This is a ground-up TPU-first design, not a port: the compute-heavy
+checkers (linearizability search, transactional cycle detection) are
+batched tensor kernels rather than graph searches over JVM objects.
+"""
+
+__version__ = "0.1.0"

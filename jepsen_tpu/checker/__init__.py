@@ -1,0 +1,566 @@
+"""Checkers: verify that a history is consistent with a model.
+
+Capability reference: jepsen/src/jepsen/checker.clj (Checker protocol
+57-72, check-safe 79-90, compose 92-104, concurrency-limit 106-121,
+unhandled-exceptions 129-157, stats 159-200, linearizable 202-233, queue
+235-255, set 257-317, set-full 320-612, total-queue 648-708, unique-ids
+710-747, counter 749-819, log-file-pattern 863-905). The reference runs
+tesser fork-join folds over history chunks; the O(n) checkers here fold
+directly (with numpy where it pays), and the search-heavy checkers
+dispatch to the TPU kernels in jepsen_tpu.tpu.
+"""
+
+from __future__ import annotations
+
+import logging
+import re
+import subprocess
+import threading
+import traceback
+from collections import Counter
+from typing import Any, Callable
+
+from .. import history as h
+from .. import util
+from ..history import History, Op
+from . import models as model
+
+logger = logging.getLogger(__name__)
+
+
+class Checker:
+    def check(self, test, history: History, opts: dict | None = None) -> dict:
+        """Returns at least {'valid?': True|False|'unknown'}. opts may
+        include 'subdirectory' for output files."""
+        raise NotImplementedError
+
+
+def _as_history(hist) -> History:
+    if isinstance(hist, History):
+        return hist
+    return History(hist)
+
+
+def check(checker: Checker, test, hist, opts=None) -> dict:
+    return checker.check(test, _as_history(hist), opts or {})
+
+
+def check_safe(checker: Checker, test, hist, opts=None) -> dict:
+    """check, but exceptions degrade to valid? 'unknown'
+    (checker.clj:79-90)."""
+    try:
+        return check(checker, test, hist, opts)
+    except Exception:  # noqa: BLE001
+        logger.exception("Error while checking history:")
+        return {"valid?": "unknown", "error": traceback.format_exc()}
+
+
+def merge_valid(valids) -> Any:
+    """false dominates, then unknown, else true."""
+    out: Any = True
+    for v in valids:
+        if v is False:
+            return False
+        if v == "unknown":
+            out = "unknown"
+    return out
+
+
+class _Fn(Checker):
+    def __init__(self, fn):
+        self.fn = fn
+
+    def check(self, test, hist, opts=None):
+        return self.fn(test, hist, opts or {})
+
+
+def checker(fn) -> Checker:
+    """Wraps fn(test, history, opts) -> result as a Checker."""
+    return _Fn(fn)
+
+
+def noop() -> Checker:
+    return _Fn(lambda test, hist, opts: None)
+
+
+def unbridled_optimism() -> Checker:
+    """Everything is awesome."""
+    return _Fn(lambda test, hist, opts: {"valid?": True})
+
+
+class Compose(Checker):
+    """Runs named checkers in parallel; valid? is the merge of all
+    (checker.clj:92-104)."""
+
+    def __init__(self, checker_map: dict):
+        self.checker_map = dict(checker_map)
+
+    def check(self, test, hist, opts=None):
+        opts = opts or {}
+        items = list(self.checker_map.items())
+        outs = util.bounded_pmap(
+            lambda kv: (kv[0], check_safe(kv[1], test, hist, opts)), items,
+            limit=8)
+        results = dict(outs)
+        results["valid?"] = merge_valid(
+            (r or {}).get("valid?") for r in results.values()
+            if isinstance(r, dict))
+        return results
+
+
+def compose(checker_map: dict) -> Checker:
+    return Compose(checker_map)
+
+
+class ConcurrencyLimit(Checker):
+    """Bounds concurrent executions of a checker (checker.clj:106-121)."""
+
+    def __init__(self, limit: int, inner: Checker):
+        self.sem = threading.Semaphore(limit)
+        self.inner = inner
+
+    def check(self, test, hist, opts=None):
+        with self.sem:
+            return self.inner.check(test, hist, opts)
+
+
+def concurrency_limit(limit: int, inner: Checker) -> Checker:
+    return ConcurrencyLimit(limit, inner)
+
+
+# ---------------------------------------------------------------------------
+# Stats + exceptions
+# ---------------------------------------------------------------------------
+
+def _stats_fold(ops) -> dict:
+    oks = infos = fails = 0
+    for o in ops:
+        if o.type == "ok":
+            oks += 1
+        elif o.type == "info":
+            infos += 1
+        elif o.type == "fail":
+            fails += 1
+    return {"valid?": oks > 0, "count": oks + infos + fails,
+            "ok-count": oks, "fail-count": fails, "info-count": infos}
+
+
+def stats() -> Checker:
+    """Success/failure rates, overall and by :f; valid only if every :f has
+    some ok ops (checker.clj:159-200)."""
+
+    def run(test, hist, opts):
+        ops = [o for o in hist if o.type != "invoke" and h.is_client_op(o)]
+        all_stats = _stats_fold(ops)
+        by_f: dict = {}
+        for o in ops:
+            by_f.setdefault(o.f, []).append(o)
+        by_f = {f: _stats_fold(l) for f, l in sorted(
+            by_f.items(), key=lambda kv: str(kv[0]))}
+        out = dict(all_stats)
+        out["by-f"] = by_f
+        out["valid?"] = merge_valid(r["valid?"] for r in by_f.values())
+        return out
+
+    return _Fn(run)
+
+
+def unhandled_exceptions() -> Checker:
+    """Frequency table of exceptions recorded in :info ops
+    (checker.clj:129-157)."""
+
+    def run(test, hist, opts):
+        by_class: dict = {}
+        for o in hist:
+            if o.type == "info" and o.get("exception"):
+                cls = str(o.get("exception")).strip().splitlines()[-1][:120]
+                by_class.setdefault(cls, []).append(o)
+        exes = [{"count": len(ops), "class": cls, "example": ops[0]}
+                for cls, ops in sorted(by_class.items(),
+                                       key=lambda kv: -len(kv[1]))]
+        out = {"valid?": True}
+        if exes:
+            out["exceptions"] = exes
+        return out
+
+    return _Fn(run)
+
+
+# ---------------------------------------------------------------------------
+# Linearizability
+# ---------------------------------------------------------------------------
+
+def linearizable(opts: dict) -> Checker:
+    """Validates linearizability. opts: {'model': Model, 'algorithm':
+    'tpu' (default) | 'wgl'}. 'wgl' is the pure-host reference search;
+    'tpu' is the batched frontier kernel (checker.clj:202-233; the
+    reference delegates to knossos competition/linear/wgl).
+    """
+    m = opts.get("model")
+    assert m is not None, "the linearizable checker requires a model"
+    algorithm = opts.get("algorithm", "tpu")
+
+    def run(test, hist, copts):
+        from ..tpu import wgl
+        a = wgl.analysis(m, hist, algorithm=algorithm)
+        a["final-paths"] = a.get("final-paths", [])[:10]
+        a["configs"] = a.get("configs", [])[:10]
+        return a
+
+    return _Fn(run)
+
+
+# ---------------------------------------------------------------------------
+# Queue / set / counter families
+# ---------------------------------------------------------------------------
+
+def queue(m: model.Model) -> Checker:
+    """Assume every non-failing enqueue succeeded and only ok dequeues
+    happened; fold the model over that (checker.clj:235-255)."""
+
+    def run(test, hist, opts):
+        final = m
+        for o in hist:
+            if o.f == "enqueue" and o.type == "invoke":
+                final = model.step(final, o)
+            elif o.f == "dequeue" and o.type == "ok":
+                final = model.step(final, o)
+        if model.is_inconsistent(final):
+            return {"valid?": False, "error": final.msg}
+        return {"valid?": True, "final-queue": final}
+
+    return _Fn(run)
+
+
+def set_checker() -> Checker:
+    """Adds followed by a final read: every ok add must be read; only
+    attempted adds may appear (checker.clj:257-317)."""
+
+    def run(test, hist, opts):
+        attempts = {o.value for o in hist
+                    if o.type == "invoke" and o.f == "add"}
+        adds = {o.value for o in hist if o.type == "ok" and o.f == "add"}
+        final_read = None
+        for o in hist:
+            if o.f == "read" and o.type == "ok":
+                final_read = o.value
+        if final_read is None:
+            return {"valid?": "unknown", "error": "Set was never read"}
+        final = set(final_read)
+        ok = final & attempts
+        unexpected = final - attempts
+        lost = adds - final
+        recovered = ok - adds
+        return {
+            "valid?": not lost and not unexpected,
+            "attempt-count": len(attempts),
+            "acknowledged-count": len(adds),
+            "ok-count": len(ok),
+            "lost-count": len(lost),
+            "recovered-count": len(recovered),
+            "unexpected-count": len(unexpected),
+            "ok": util.integer_interval_set_str(ok)
+            if _all_ints(ok) else sorted(ok, key=str),
+            "lost": util.integer_interval_set_str(lost)
+            if _all_ints(lost) else sorted(lost, key=str),
+            "unexpected": util.integer_interval_set_str(unexpected)
+            if _all_ints(unexpected) else sorted(unexpected, key=str),
+            "recovered": util.integer_interval_set_str(recovered)
+            if _all_ints(recovered) else sorted(recovered, key=str),
+        }
+
+    return _Fn(run)
+
+
+def _all_ints(xs) -> bool:
+    return all(isinstance(x, int) for x in xs)
+
+
+class _SetFullElement:
+    """Per-element lifecycle state (checker.clj SetFullElement,
+    330-433)."""
+
+    __slots__ = ("element", "known", "last_present", "last_absent")
+
+    def __init__(self, element):
+        self.element = element
+        self.known = None          # completion op confirming existence
+        self.last_present = None   # latest read invocation observing it
+        self.last_absent = None    # latest read invocation missing it
+
+    def add_ok(self, op):
+        if self.known is None:
+            self.known = op
+
+    def read_present(self, inv, op):
+        if self.known is None:
+            self.known = op
+        if self.last_present is None or self.last_present.index < inv.index:
+            self.last_present = inv
+
+    def read_absent(self, inv, op):
+        if self.last_absent is None or self.last_absent.index < inv.index:
+            self.last_absent = inv
+
+    def results(self) -> dict:
+        lp = self.last_present.index if self.last_present else -1
+        la = self.last_absent.index if self.last_absent else -1
+        stable = bool(self.last_present and la < lp)
+        lost = bool(self.known and self.last_absent and lp < la
+                    and self.known.index < la)
+        stable_time = ((self.last_absent.time + 1 if self.last_absent else 0)
+                       if stable else None)
+        lost_time = ((self.last_present.time + 1 if self.last_present else 0)
+                     if lost else None)
+        known_time = self.known.time if self.known else 0
+        stable_latency = (max(0, stable_time - known_time) // 1_000_000
+                          if stable else None)
+        lost_latency = (max(0, lost_time - known_time) // 1_000_000
+                        if lost else None)
+        return {"element": self.element,
+                "outcome": ("stable" if stable
+                            else "lost" if lost else "never-read"),
+                "stable-latency": stable_latency,
+                "lost-latency": lost_latency,
+                "known": self.known,
+                "last-absent": self.last_absent}
+
+
+def _frequency_distribution(points, values):
+    values = sorted(values)
+    if not values:
+        return None
+    n = len(values)
+    return {p: values[min(n - 1, int(n * p))] for p in points}
+
+
+def set_full(checker_opts: dict | None = None) -> Checker:
+    """Rigorous per-element set analysis: stable/lost/never-read outcomes
+    with stable/lost latencies (checker.clj:320-612)."""
+    copts = {"linearizable?": False}
+    copts.update(checker_opts or {})
+
+    def run(test, hist, opts):
+        elements: dict = {}
+        dups: dict = {}
+        for op in hist:
+            if not h.is_client_op(op):
+                continue
+            if op.f == "add":
+                if op.type == "invoke":
+                    elements[op.value] = _SetFullElement(op.value)
+                elif op.type == "ok" and op.value in elements:
+                    elements[op.value].add_ok(op)
+            elif op.f == "read" and op.type == "ok":
+                inv = hist.invocation(op)
+                if inv is None:
+                    continue
+                vals = op.value or []
+                for k, n in Counter(vals).items():
+                    if n > 1:
+                        dups[k] = max(dups.get(k, 0), n)
+                vset = set(vals)
+                for element, state in elements.items():
+                    if element in vset:
+                        state.read_present(inv, op)
+                    else:
+                        state.read_absent(inv, op)
+        rs = [e.results() for _k, e in sorted(elements.items(),
+                                              key=lambda kv: str(kv[0]))]
+        outcomes: dict = {}
+        for r in rs:
+            outcomes.setdefault(r["outcome"], []).append(r)
+        stale = [r for r in outcomes.get("stable", [])
+                 if r["stable-latency"] and r["stable-latency"] > 0]
+        stable_lat = [r["stable-latency"] for r in rs
+                      if r["stable-latency"] is not None]
+        lost_lat = [r["lost-latency"] for r in rs
+                    if r["lost-latency"] is not None]
+        lost_n = len(outcomes.get("lost", []))
+        stable_n = len(outcomes.get("stable", []))
+        valid: Any = True
+        if lost_n > 0:
+            valid = False
+        elif stable_n == 0:
+            valid = "unknown"
+        elif copts.get("linearizable?") and stale:
+            valid = False
+        out = {
+            "valid?": (False if dups else valid),
+            "attempt-count": len(rs),
+            "stable-count": stable_n,
+            "lost-count": lost_n,
+            "lost": sorted((r["element"] for r in outcomes.get("lost", [])),
+                           key=str),
+            "never-read-count": len(outcomes.get("never-read", [])),
+            "never-read": sorted((r["element"]
+                                  for r in outcomes.get("never-read", [])),
+                                 key=str),
+            "stale-count": len(stale),
+            "stale": sorted((r["element"] for r in stale), key=str),
+            "worst-stale": sorted(stale, key=lambda r: -r["stable-latency"]
+                                  )[:8],
+            "duplicated-count": len(dups),
+            "duplicated": dups,
+        }
+        points = [0, 0.5, 0.95, 0.99, 1]
+        if stable_lat:
+            out["stable-latencies"] = _frequency_distribution(
+                points, stable_lat)
+        if lost_lat:
+            out["lost-latencies"] = _frequency_distribution(points, lost_lat)
+        return out
+
+    return _Fn(run)
+
+
+def _expand_drains(hist: History) -> list:
+    """Expands ok :drain ops into dequeue invoke/ok pairs
+    (checker.clj:614-646)."""
+    out = []
+    for op in hist:
+        if op.f != "drain":
+            out.append(op)
+        elif op.type in ("invoke", "fail"):
+            continue
+        elif op.type == "ok":
+            for element in op.value or []:
+                out.append(op.copy(index=-1, type="invoke", f="dequeue",
+                                   value=None))
+                out.append(op.copy(index=-1, type="ok", f="dequeue",
+                                   value=element))
+        else:
+            raise ValueError(f"crashed drain operation: {op!r}")
+    return out
+
+
+def total_queue() -> Checker:
+    """What goes in must come out; requires a fully drained queue
+    (checker.clj:648-708)."""
+
+    def run(test, hist, opts):
+        ops = _expand_drains(hist)
+        attempts = Counter(o.value for o in ops
+                           if o.f == "enqueue" and o.type == "invoke")
+        enqueues = Counter(o.value for o in ops
+                           if o.f == "enqueue" and o.type == "ok")
+        dequeues = Counter(o.value for o in ops
+                           if o.f == "dequeue" and o.type == "ok")
+        ok = dequeues & attempts
+        unexpected = Counter({k: n for k, n in dequeues.items()
+                              if k not in attempts})
+        duplicated = dequeues - attempts - unexpected
+        lost = enqueues - dequeues
+        recovered = ok - enqueues
+        return {
+            "valid?": not lost and not unexpected,
+            "attempt-count": sum(attempts.values()),
+            "acknowledged-count": sum(enqueues.values()),
+            "ok-count": sum(ok.values()),
+            "unexpected-count": sum(unexpected.values()),
+            "duplicated-count": sum(duplicated.values()),
+            "lost-count": sum(lost.values()),
+            "recovered-count": sum(recovered.values()),
+            "lost": dict(lost),
+            "unexpected": dict(unexpected),
+            "duplicated": dict(duplicated),
+            "recovered": dict(recovered),
+        }
+
+    return _Fn(run)
+
+
+def unique_ids() -> Checker:
+    """A unique-id generator must emit unique ids (checker.clj:710-747)."""
+
+    def run(test, hist, opts):
+        attempted = sum(1 for o in hist
+                        if o.f == "generate" and o.type == "invoke")
+        acks = [o.value for o in hist
+                if o.f == "generate" and o.type == "ok"]
+        freqs = Counter(acks)
+        dups = {k: n for k, n in freqs.items() if n > 1}
+        rng = [min(acks), max(acks)] if acks else None
+        return {
+            "valid?": not dups,
+            "attempted-count": attempted,
+            "acknowledged-count": len(acks),
+            "duplicated-count": len(dups),
+            "duplicated": dict(sorted(dups.items(),
+                                      key=lambda kv: -kv[1])[:48]),
+            "range": rng,
+        }
+
+    return _Fn(run)
+
+
+def counter() -> Checker:
+    """At each read, value must lie between the sum of ok increments and
+    the sum of attempted increments (checker.clj:749-819)."""
+
+    def run(test, hist, opts):
+        lower = 0
+        upper = 0
+        pending_reads: dict = {}
+        reads = []
+        for op in hist:
+            key = (op.type, op.f)
+            if key == ("invoke", "read"):
+                completion = hist.completion(op)
+                if completion is not None and completion.type == "ok":
+                    pending_reads[op.process] = [lower, completion.value]
+            elif key == ("ok", "read"):
+                r = pending_reads.pop(op.process, None)
+                if r is not None:
+                    reads.append([r[0], r[1], upper])
+            elif key == ("invoke", "add"):
+                assert op.value >= 0, "counter checker assumes increments"
+                completion = hist.completion(op)
+                if completion is None or completion.type != "fail":
+                    upper += op.value
+            elif key == ("ok", "add"):
+                lower += op.value
+        errors = [r for r in reads if not (r[0] <= r[1] <= r[2])]
+        return {"valid?": not errors, "reads": reads, "errors": errors}
+
+    return _Fn(run)
+
+
+def log_file_pattern(pattern: str, filename: str) -> Checker:
+    """Greps downloaded node logs in the store dir for a pattern
+    (checker.clj:863-905)."""
+
+    def run(test, hist, opts):
+        from .. import store as jstore
+
+        matches = []
+        for node in test.get("nodes") or []:
+            path = jstore.path(test, str(node), filename)
+            if not path.exists():
+                continue
+            try:
+                text = path.read_text(errors="replace")
+            except OSError:
+                continue
+            for line in text.splitlines():
+                if re.search(pattern, line):
+                    matches.append({"node": node, "line": line})
+        return {"valid?": not matches, "count": len(matches),
+                "matches": matches}
+
+    return _Fn(run)
+
+
+def perf(opts: dict | None = None) -> Checker:
+    """Latency + rate graphs (checker/perf.clj); see jepsen_tpu.checker.perf."""
+    from . import perf as perf_mod
+
+    return compose({"latency-graph": perf_mod.latency_graph(opts),
+                    "rate-graph": perf_mod.rate_graph(opts)})
+
+
+def clock_plot() -> Checker:
+    from . import clock as clock_mod
+
+    return _Fn(lambda test, hist, opts:
+               clock_mod.plot(test, hist, opts) or {"valid?": True})

@@ -1,0 +1,148 @@
+"""Client protocol: applies operations to the database under test.
+
+Capability reference: jepsen/src/jepsen/client.clj (Client protocol 9-27,
+Reusable 29-44, Validate 64-114, timeout wrapper 116-148, noop client).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+from . import util
+from .history import Op
+
+
+class Client:
+    """A client opens a connection to one node and applies ops.
+
+    Lifecycle: open(test, node) -> setup(test) -> invoke(test, op)* ->
+    teardown(test) -> close(test). open/close must not affect the logical
+    state of the test."""
+
+    def open(self, test, node) -> "Client":
+        return self
+
+    def setup(self, test) -> "Client":
+        return self
+
+    def invoke(self, test, op: Op) -> Op:
+        raise NotImplementedError
+
+    def teardown(self, test) -> None:
+        pass
+
+    def close(self, test) -> None:
+        pass
+
+    def reusable(self, test) -> bool:
+        """If True, this client survives a crashed invocation and can be
+        reused by the replacement process (Reusable protocol,
+        client.clj:29-44)."""
+        return False
+
+
+def is_reusable(client, test) -> bool:
+    try:
+        return bool(client.reusable(test))
+    except Exception:  # noqa: BLE001 - parity with is-reusable? fallback
+        return False
+
+
+class NoopClient(Client):
+    """Completes every op :ok without talking to anything."""
+
+    def invoke(self, test, op):
+        return op.copy(type="ok")
+
+
+noop = NoopClient()
+
+
+class InvalidCompletion(Exception):
+    def __init__(self, op, op2, problems):
+        self.op = op
+        self.op2 = op2
+        self.problems = problems
+        super().__init__(f"invalid completion for {op!r}: {op2!r} ({problems})")
+
+
+class Validate(Client):
+    """Asserts invoke returns a completion with legal type and unchanged
+    process/f (client.clj:64-114)."""
+
+    def __init__(self, client: Client):
+        self.client = client
+
+    def open(self, test, node):
+        res = self.client.open(test, node)
+        if not isinstance(res, Client):
+            raise TypeError(f"open should return a Client, got {res!r}")
+        return Validate(res)
+
+    def setup(self, test):
+        return Validate(self.client.setup(test))
+
+    def invoke(self, test, op):
+        op2 = self.client.invoke(test, op)
+        problems = []
+        if not isinstance(op2, Op):
+            problems.append("should be an Op")
+        else:
+            if op2.type not in ("ok", "info", "fail"):
+                problems.append("type should be ok, info, or fail")
+            if op2.process != op.process:
+                problems.append("process should be the same")
+            if op2.f != op.f:
+                problems.append("f should be the same")
+        if problems:
+            raise InvalidCompletion(op, op2, problems)
+        return op2
+
+    def teardown(self, test):
+        self.client.teardown(test)
+
+    def close(self, test):
+        self.client.close(test)
+
+    def reusable(self, test):
+        return is_reusable(self.client, test)
+
+
+def validate(client: Client) -> Validate:
+    return Validate(client)
+
+
+class Timeout(Client):
+    """Times out invocations after timeout_ms (or (f op) -> ms), completing
+    them :info with error 'timeout' (client.clj:116-148)."""
+
+    def __init__(self, timeout_fn: Callable[[Op], float], client: Client):
+        self.timeout_fn = timeout_fn
+        self.client = client
+
+    def open(self, test, node):
+        return Timeout(self.timeout_fn, self.client.open(test, node))
+
+    def setup(self, test):
+        return Timeout(self.timeout_fn, self.client.setup(test))
+
+    def invoke(self, test, op):
+        ms = self.timeout_fn(op)
+        return util.timeout(ms / 1000.0,
+                            lambda: self.client.invoke(test, op),
+                            default=op.copy(type="info", error="timeout"))
+
+    def teardown(self, test):
+        self.client.teardown(test)
+
+    def close(self, test):
+        self.client.close(test)
+
+    def reusable(self, test):
+        return is_reusable(self.client, test)
+
+
+def timeout(timeout_or_fn, client: Client) -> Timeout:
+    if callable(timeout_or_fn):
+        return Timeout(timeout_or_fn, client)
+    return Timeout(lambda _op: timeout_or_fn, client)

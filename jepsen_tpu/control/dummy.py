@@ -1,0 +1,34 @@
+"""A no-op remote: every control action silently succeeds.
+
+Capability reference: the clj-ssh remote's :dummy? mode
+(jepsen/src/jepsen/control/clj_ssh.clj:43-85), which is how the reference
+runs its entire lifecycle clusterless in tests.
+"""
+
+from __future__ import annotations
+
+from .core import Action, Remote, Result, Session
+
+
+class DummySession(Session):
+    def __init__(self, node):
+        self.node = node
+        self.log: list = []  # actions recorded for test assertions
+
+    def execute(self, action: Action) -> Result:
+        self.log.append(action)
+        return Result(exit=0, out="", err="", cmd=action.cmd)
+
+    def upload(self, local_paths, remote_path) -> None:
+        self.log.append(("upload", local_paths, remote_path))
+
+    def download(self, remote_paths, local_path) -> None:
+        self.log.append(("download", remote_paths, local_path))
+
+
+class DummyRemote(Remote):
+    def connect(self, conn_spec: dict) -> DummySession:
+        return DummySession(conn_spec.get("host"))
+
+
+dummy = DummyRemote()

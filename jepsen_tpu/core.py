@@ -1,0 +1,230 @@
+"""Test lifecycle orchestration.
+
+A test is a plain dict. `run(test)` opens control sessions, sets up
+OS/DB, spawns clients and nemesis, drives the generator through the
+interpreter, tears everything down, checks the history, and returns the
+test with :history and :results.
+
+Capability reference: jepsen/src/jepsen/core.clj (run! 322-412,
+prepare-test 302-320, with-resources 69-90, with-os 92-99, with-db
+164-173, client+nemesis setup/teardown 175-206, run-case! 208-213,
+analyze! 215-228, snarf-logs! 101-162, synchronize 43-56).
+"""
+
+from __future__ import annotations
+
+import datetime
+import logging
+import threading
+from typing import Any
+
+from . import client as jclient
+from . import control
+from . import db as jdb
+from . import interpreter
+from . import nemesis as jnemesis
+from . import util
+from .history import History
+
+logger = logging.getLogger(__name__)
+
+NO_BARRIER = "::no-barrier"
+
+
+def synchronize(test: dict, timeout_s: float = 60.0) -> None:
+    """Blocks until all nodes arrive at the same point (core.clj:43-56)."""
+    barrier = test.get("barrier")
+    if barrier == NO_BARRIER or barrier is None:
+        return
+    barrier.wait(timeout=timeout_s)
+
+
+def primary(test: dict):
+    return test["nodes"][0]
+
+
+def prepare_test(test: dict) -> dict:
+    """Fills in :start-time, :concurrency, :barrier (core.clj:302-320)."""
+    test = dict(test)
+    if not test.get("start_time"):
+        test["start_time"] = datetime.datetime.now()
+    if not test.get("concurrency"):
+        test["concurrency"] = len(test.get("nodes") or [])
+    if not test.get("barrier"):
+        n = len(test.get("nodes") or [])
+        test["barrier"] = threading.Barrier(n) if n > 0 else NO_BARRIER
+    return test
+
+
+def _setup_os(test: dict) -> None:
+    os_ = test.get("os")
+    if os_ is not None:
+        control.on_nodes(test, lambda t, n: os_.setup(t, n))
+
+
+def _teardown_os(test: dict) -> None:
+    os_ = test.get("os")
+    if os_ is not None:
+        control.on_nodes(test, lambda t, n: os_.teardown(t, n))
+
+
+def _db_cycle(test: dict) -> None:
+    """Tears down then sets up the DB on all nodes, with primary setup
+    (db.clj cycle!)."""
+    db = test.get("db")
+    if db is None:
+        return
+
+    def once():
+        control.on_nodes(test, lambda t, n: db.teardown(t, n))
+        if db.supports_primaries:
+            db.setup_primary(test, primary(test))
+        control.on_nodes(test, lambda t, n: db.setup(t, n))
+
+    util.with_retry(once, retries=2, backoff=1.0)
+
+
+def _teardown_db(test: dict) -> None:
+    db = test.get("db")
+    if db is not None and not test.get("leave_db_running?"):
+        control.on_nodes(test, lambda t, n: db.teardown(t, n))
+
+
+def snarf_logs(test: dict) -> None:
+    """Downloads DB log files into the store directory
+    (core.clj:101-128)."""
+    db = test.get("db")
+    if db is None:
+        return
+    try:
+        from . import store as jstore
+    except ImportError:
+        return
+    if not test.get("name") or not test.get("start_time"):
+        return
+
+    def snarf(t, node):
+        files = jdb.log_files_map(db, t, node)
+        for remote, local in files.items():
+            try:
+                dest = jstore.path(t, str(node), local.lstrip("/"))
+                dest.parent.mkdir(parents=True, exist_ok=True)
+                control.download([remote], dest)
+            except Exception as e:  # noqa: BLE001
+                logger.info("couldn't download %s: %s", remote, e)
+
+    try:
+        control.on_nodes(test, snarf)
+    except Exception:  # noqa: BLE001
+        logger.exception("Error snarfing logs")
+
+
+def run_case(test: dict) -> dict:
+    """Sets up clients + nemesis, runs the generator via the interpreter,
+    tears them down (core.clj:175-213)."""
+    client = test["client"]
+    nem = jnemesis.validate(test.get("nemesis") or jnemesis.noop)
+
+    nem_box: dict = {}
+
+    def setup_nemesis():
+        try:
+            nem_box["nem"] = nem.setup(test)
+        except BaseException as e:  # noqa: BLE001 - re-raised on the caller
+            nem_box["error"] = e
+
+    nem_thread = threading.Thread(target=setup_nemesis, daemon=True)
+    nem_thread.start()
+
+    def open_one(node):
+        c = jclient.validate(client).open(test, node)
+        c.setup(test)
+        return c
+
+    clients = util.real_pmap(open_one, test.get("nodes") or [])
+    nem_thread.join()
+    if "error" in nem_box:
+        raise nem_box["error"]
+    nemesis_up = nem_box["nem"]
+    test = dict(test)
+    test["nemesis"] = nemesis_up
+    try:
+        return interpreter.run(test)
+    finally:
+        def teardown_nem():
+            nemesis_up.teardown(test)
+
+        nt = threading.Thread(target=teardown_nem, daemon=True)
+        nt.start()
+
+        def close_one(c):
+            try:
+                c.teardown(test)
+            finally:
+                c.close(test)
+
+        util.real_pmap(close_one, clients)
+        nt.join()
+
+
+def analyze(test: dict) -> dict:
+    """Runs the checker over the history (core.clj:215-228)."""
+    from . import checker as jchecker
+
+    logger.info("Analyzing...")
+    checker = test.get("checker")
+    if checker is None:
+        checker = jchecker.unbridled_optimism()
+    test = dict(test)
+    test["results"] = jchecker.check_safe(checker, test, test["history"])
+    logger.info("Analysis complete")
+    return test
+
+
+def log_results(test: dict) -> dict:
+    results = test.get("results") or {}
+    valid = results.get("valid?")
+    if valid is True:
+        logger.info("Everything looks good! (results valid)")
+    elif valid == "unknown":
+        logger.info("Errors during analysis, but no anomalies found.")
+    else:
+        logger.info("Analysis invalid!")
+    return test
+
+
+def run(test: dict) -> dict:
+    """Full lifecycle (core.clj:322-412)."""
+    test = prepare_test(test)
+
+    store_ctx = None
+    if test.get("name"):
+        try:
+            from . import store as jstore
+            store_ctx = jstore
+            test = jstore.start_test(test)
+        except ImportError:
+            store_ctx = None
+
+    with util.with_relative_time():
+        test = control.open_sessions(test)
+        try:
+            _setup_os(test)
+            try:
+                _db_cycle(test)
+                try:
+                    test = run_case(test)
+                    if store_ctx:
+                        store_ctx.save_history(test)
+                    snarf_logs(test)
+                finally:
+                    _teardown_db(test)
+            finally:
+                _teardown_os(test)
+        finally:
+            control.close_sessions(test)
+
+    test = analyze(test)
+    if store_ctx:
+        store_ctx.save_results(test)
+    return log_results(test)

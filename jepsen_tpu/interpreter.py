@@ -1,0 +1,257 @@
+"""The execution engine: interprets operations from a pure generator,
+spawning one worker thread per logical thread, applying ops through
+clients/nemeses, and journaling invocations + completions to a history.
+
+Capability reference: jepsen/src/jepsen/generator/interpreter.clj (Worker
+protocol 22-34, ClientWorker 36-70, spawn-worker 102-167, run! 184-337).
+The hot-loop structure is preserved: poll completions first (they're
+latency-sensitive), then ask the generator, dispatch with a 1-slot
+inbound queue per worker, crash-to-:info conversion, process
+reincarnation on :info, and incremental history writes.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+import traceback
+from typing import Any
+
+from . import client as jclient
+from . import generator as gen
+from . import util
+from .generator.context import NEMESIS
+from .history import History, Op
+
+logger = logging.getLogger(__name__)
+
+# When the generator is :pending, the max interval before re-checking (µs)
+# (interpreter.clj:169-173).
+MAX_PENDING_INTERVAL_US = 1000
+
+
+def goes_in_history(op: Op) -> bool:
+    """:sleep and :log ops are not journaled (interpreter.clj:175-182)."""
+    return op.type not in ("sleep", "log")
+
+
+class Worker:
+    """Stateful per-thread op executor; all calls on one thread
+    (interpreter.clj:22-34)."""
+
+    def open(self, test, wid) -> "Worker":
+        return self
+
+    def invoke(self, test, op: Op) -> Op:
+        raise NotImplementedError
+
+    def close(self, test) -> None:
+        pass
+
+
+class ClientWorker(Worker):
+    """Wraps a client, reopening it whenever the process changes and the
+    client isn't reusable (interpreter.clj:36-70)."""
+
+    def __init__(self, node):
+        self.node = node
+        self.process = None
+        self.client = None
+
+    def invoke(self, test, op):
+        while True:
+            if (self.process != op.process
+                    and not jclient.is_reusable(self.client, test)):
+                self.close(test)
+                try:
+                    self.client = jclient.validate(test["client"]).open(
+                        test, self.node)
+                    self.process = op.process
+                except Exception as e:  # noqa: BLE001
+                    logger.warning("Error opening client: %s", e)
+                    self.client = None
+                    return op.copy(type="fail",
+                                   error=["no-client", str(e)])
+                continue
+            return self.client.invoke(test, op)
+
+    def close(self, test):
+        if self.client is not None:
+            self.client.close(test)
+            self.client = None
+
+
+class NemesisWorker(Worker):
+    def invoke(self, test, op):
+        return test["nemesis"].invoke(test, op)
+
+
+class ClientNemesisWorker(Worker):
+    """Spawns client workers for integer ids, a nemesis worker otherwise
+    (interpreter.clj:81-95)."""
+
+    def open(self, test, wid):
+        if isinstance(wid, int):
+            nodes = list(test.get("nodes") or [None])
+            return ClientWorker(nodes[wid % len(nodes)])
+        return NemesisWorker()
+
+
+def spawn_worker(test, out: queue.Queue, worker: Worker, wid):
+    """One thread + 1-slot inbound queue per worker
+    (interpreter.clj:102-167). Returns {'id','thread','in'}."""
+    inq: queue.Queue = queue.Queue(maxsize=1)
+
+    def run():
+        w = worker.open(test, wid)
+        try:
+            while True:
+                op = inq.get()
+                try:
+                    if op.type == "exit":
+                        return
+                    if op.type == "sleep":
+                        import time as _t
+                        _t.sleep(op.value)
+                        out.put(op)
+                    elif op.type == "log":
+                        logger.info("%s", op.value)
+                        out.put(op)
+                    else:
+                        op2 = w.invoke(test, op)
+                        out.put(op2)
+                except Exception as e:  # noqa: BLE001 - crash becomes :info
+                    logger.warning("Process %s crashed: %s", op.process, e)
+                    out.put(op.copy(
+                        type="info",
+                        exception=traceback.format_exc(),
+                        error=f"indeterminate: {e}"))
+        finally:
+            try:
+                w.close(test)
+            except Exception:  # noqa: BLE001
+                logger.exception("Error closing worker %s", wid)
+
+    t = threading.Thread(target=run, name=f"jepsen-worker-{wid}", daemon=True)
+    t.start()
+    return {"id": wid, "thread": t, "in": inq}
+
+
+class MemoryHistoryWriter:
+    """In-memory history sink (the disk-backed writer lives in
+    jepsen_tpu.store.format)."""
+
+    def __init__(self):
+        self.ops: list[Op] = []
+
+    def append(self, op: Op) -> None:
+        self.ops.append(op)
+
+    def close(self) -> None:
+        pass
+
+    def read_back(self) -> History:
+        return History(self.ops, assign_indices=False)
+
+
+def run(test: dict) -> dict:
+    """Runs (:generator test) against (:client test)/(:nemesis test),
+    returning the test with a completed :history (interpreter.clj:184-337).
+    """
+    writer = test.get("history_writer") or MemoryHistoryWriter()
+    ctx = gen.context(test)
+    worker_ids = ctx.all_thread_names()
+    completions: queue.Queue = queue.Queue(maxsize=len(worker_ids))
+    workers = [spawn_worker(test, completions, ClientNemesisWorker(), wid)
+               for wid in worker_ids]
+    invocations = {w["id"]: w["in"] for w in workers}
+    g = gen.validate(gen.friendly_exceptions(test.get("generator")))
+    test = dict(test)
+    test.pop("generator", None)
+
+    op_index = 0
+    outstanding = 0
+    poll_timeout_us = 0
+    try:
+        while True:
+            op2 = None
+            if poll_timeout_us > 0:
+                try:
+                    op2 = completions.get(timeout=poll_timeout_us / 1e6)
+                except queue.Empty:
+                    op2 = None
+            else:
+                try:
+                    op2 = completions.get_nowait()
+                except queue.Empty:
+                    op2 = None
+
+            if op2 is not None:
+                # Completion path (interpreter.clj:228-256).
+                thread = ctx.process_to_thread_name(op2.process)
+                now = util.relative_time_nanos()
+                op2 = op2.copy(index=op_index, time=now)
+                ctx = ctx.free_thread(now, thread)
+                g = gen.update(g, test, ctx, op2)
+                if thread != NEMESIS and (op2.type == "info"
+                                          or op2.get("end_process?")):
+                    ctx = ctx.with_next_process(thread)
+                if goes_in_history(op2):
+                    writer.append(op2)
+                    op_index += 1
+                outstanding -= 1
+                poll_timeout_us = 0
+                continue
+
+            # Ask the generator (interpreter.clj:258-318).
+            now = util.relative_time_nanos()
+            ctx = ctx.with_time(now)
+            res = gen.op(g, test, ctx)
+            if res is None:
+                if outstanding > 0:
+                    poll_timeout_us = MAX_PENDING_INTERVAL_US
+                    continue
+                # Done: drain workers, close writer, read history back.
+                for q in invocations.values():
+                    q.put(Op(type="exit"))
+                for w in workers:
+                    w["thread"].join()
+                writer.close()
+                test["history"] = writer.read_back()
+                return test
+
+            op_, g2 = res
+            if op_ is gen.PENDING:
+                # Keep the pre-call generator state, like the reference
+                # (interpreter.clj:290-291).
+                poll_timeout_us = MAX_PENDING_INTERVAL_US
+                continue
+
+            if now < op_.time:
+                # Not due yet: leave g unconsumed and re-ask once the op
+                # is due or a completion changes circumstances
+                # (interpreter.clj:294-300).
+                poll_timeout_us = max(1, (op_.time - now) // 1000)
+                continue
+
+            # Dispatch (interpreter.clj:302-318).
+            thread = ctx.process_to_thread_name(op_.process)
+            op_ = op_.copy(index=op_index)
+            if goes_in_history(op_):
+                writer.append(op_)
+                op_index += 1
+            invocations[thread].put(op_)
+            ctx = ctx.busy_thread(op_.time, thread)
+            g = gen.update(g2, test, ctx, op_)
+            outstanding += 1
+            poll_timeout_us = 0
+    except BaseException:
+        logger.info("Shutting down workers after abnormal exit")
+        for w in workers:
+            if w["thread"].is_alive():
+                try:
+                    w["in"].put_nowait(Op(type="exit"))
+                except queue.Full:
+                    pass
+        raise

@@ -1,0 +1,21 @@
+"""Fault-injection nemeses.
+
+Capability reference: jepsen/src/jepsen/nemesis.clj. The core protocol and
+pure grudge/partition math live in `core`; composed packages in
+`combined`; clock manipulation in `time`.
+"""
+
+from .core import (Nemesis, NoopNemesis, Validate, noop, validate, invoke,
+                   setup, teardown, compose, f_map,
+                   bisect, split_one, complete_grudge, bridge,
+                   majorities_ring, partitioner, partition_halves,
+                   partition_random_halves, partition_random_node,
+                   partition_majorities_ring)
+
+__all__ = [
+    "Nemesis", "NoopNemesis", "Validate", "noop", "validate", "invoke",
+    "setup", "teardown", "compose", "f_map",
+    "bisect", "split_one", "complete_grudge", "bridge", "majorities_ring",
+    "partitioner", "partition_halves", "partition_random_halves",
+    "partition_random_node", "partition_majorities_ring",
+]

@@ -1,0 +1,328 @@
+"""Nemesis protocol, validation, composition, and partition grudge math.
+
+Capability reference: jepsen/src/jepsen/nemesis.clj (Nemesis protocol
+12-22, Validate 50-91, grudges 121-277, compose/f-map 286-430). Network
+application of grudges lives in jepsen_tpu.net; this module computes
+*which* links to cut, as pure functions over node lists.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Any, Callable, Iterable
+
+from ..history import Op
+
+
+class Nemesis:
+    """Fault injector driven by generator ops on the :nemesis thread."""
+
+    def setup(self, test) -> "Nemesis":
+        return self
+
+    def invoke(self, test, op: Op) -> Op:
+        raise NotImplementedError
+
+    def teardown(self, test) -> None:
+        pass
+
+    def fs(self) -> set:
+        """The set of op :f values this nemesis handles (Reflection
+        protocol, nemesis.clj:17-22)."""
+        return set()
+
+
+class NoopNemesis(Nemesis):
+    """Does nothing."""
+
+    def invoke(self, test, op):
+        return op
+
+
+noop = NoopNemesis()
+
+
+class InvalidNemesisCompletion(Exception):
+    pass
+
+
+class Validate(Nemesis):
+    """Asserts nemesis protocol invariants (nemesis.clj:50-91)."""
+
+    def __init__(self, nemesis: Nemesis):
+        self.nemesis = nemesis
+
+    def setup(self, test):
+        res = self.nemesis.setup(test)
+        if not isinstance(res, Nemesis):
+            raise InvalidNemesisCompletion(
+                f"setup should return a Nemesis, got {res!r}")
+        return Validate(res)
+
+    def invoke(self, test, op):
+        op2 = self.nemesis.invoke(test, op)
+        if not isinstance(op2, Op):
+            raise InvalidNemesisCompletion(
+                f"invoke should return an Op, got {op2!r}")
+        if op2.process != op.process:
+            raise InvalidNemesisCompletion(
+                f"process changed: {op!r} -> {op2!r}")
+        return op2
+
+    def teardown(self, test):
+        self.nemesis.teardown(test)
+
+    def fs(self):
+        return self.nemesis.fs()
+
+
+def validate(nemesis: Nemesis) -> Validate:
+    return Validate(nemesis)
+
+
+# Functional façade
+def setup(nemesis, test):
+    return nemesis.setup(test)
+
+
+def invoke(nemesis, test, op):
+    return nemesis.invoke(test, op)
+
+
+def teardown(nemesis, test):
+    return nemesis.teardown(test)
+
+
+# ---------------------------------------------------------------------------
+# Composition
+# ---------------------------------------------------------------------------
+
+class Compose(Nemesis):
+    """Routes ops to sub-nemeses by :f (nemesis.clj:286-430).
+
+    Holds (fspec, nemesis) pairs where fspec is either a set of fs
+    (forwarded unchanged) or a dict {outer-f: inner-f} (the op's :f is
+    rewritten to the inner name on the way in and restored on the way
+    out)."""
+
+    def __init__(self, pairs: list):
+        self.pairs = list(pairs)
+
+    def _route(self, f):
+        for fspec, nem in self.pairs:
+            if isinstance(fspec, dict):
+                if f in fspec:
+                    return fspec[f], nem
+            elif f in fspec:
+                return f, nem
+        return None, None
+
+    def setup(self, test):
+        return Compose([(spec, nem.setup(test))
+                        for spec, nem in self.pairs])
+
+    def invoke(self, test, op):
+        inner_f, nem = self._route(op.f)
+        if nem is None:
+            raise ValueError(f"no nemesis handles f={op.f!r}")
+        op2 = nem.invoke(test, op.copy(f=inner_f))
+        return op2.copy(f=op.f)
+
+    def teardown(self, test):
+        for _spec, nem in self.pairs:
+            nem.teardown(test)
+
+    def fs(self):
+        out = set()
+        for fspec, nem in self.pairs:
+            if isinstance(fspec, dict):
+                out |= set(fspec.keys())
+            else:
+                out |= set(fspec)
+        return out
+
+
+def compose(nemeses) -> Nemesis:
+    """Takes (fspec, nemesis) pairs — fspec a set of fs or a dict
+    {outer-f: inner-f} — or a plain list of nemeses routed by their
+    declared fs()."""
+    pairs = []
+    for item in nemeses:
+        if isinstance(item, (tuple, list)) and len(item) == 2 and (
+                isinstance(item[0], (set, frozenset, dict))):
+            pairs.append((item[0], item[1]))
+        else:
+            fs = frozenset(item.fs())
+            if not fs:
+                raise ValueError(
+                    f"{item!r} declares no fs; pass (fspec, nemesis) pairs")
+            pairs.append((fs, item))
+    return Compose(pairs)
+
+
+class FMap(Nemesis):
+    """Renames the fs a nemesis speaks: outer f -> inner f via `fmap`
+    (nemesis.clj f-map)."""
+
+    def __init__(self, fmap: dict, nemesis: Nemesis):
+        self.fmap = fmap
+        self.inv = {v: k for k, v in fmap.items()}
+        self.nemesis = nemesis
+
+    def setup(self, test):
+        return FMap(self.fmap, self.nemesis.setup(test))
+
+    def invoke(self, test, op):
+        op2 = self.nemesis.invoke(test, op.copy(f=self.fmap.get(op.f, op.f)))
+        return op2.copy(f=self.inv.get(op2.f, op2.f))
+
+    def teardown(self, test):
+        self.nemesis.teardown(test)
+
+    def fs(self):
+        inv = self.inv
+        return {inv.get(f, f) for f in self.nemesis.fs()}
+
+
+def f_map(fmap: dict, nemesis: Nemesis) -> FMap:
+    return FMap(fmap, nemesis)
+
+
+# ---------------------------------------------------------------------------
+# Grudges: who can't talk to whom. A grudge maps node -> set of nodes whose
+# packets it drops (nemesis.clj:121-277).
+# ---------------------------------------------------------------------------
+
+def bisect(nodes: list) -> list:
+    """Splits a list in half: [[smaller-half], [larger-half]]."""
+    mid = len(nodes) // 2
+    return [list(nodes[:mid]), list(nodes[mid:])]
+
+
+def split_one(node, nodes: list) -> list:
+    """[[node], [everyone else]]."""
+    return [[node], [n for n in nodes if n != node]]
+
+
+def complete_grudge(components: list) -> dict:
+    """Given components (lists of nodes), each node drops every node
+    outside its component (nemesis.clj:121-133)."""
+    grudge = {}
+    all_nodes = [n for comp in components for n in comp]
+    for comp in components:
+        outside = set(all_nodes) - set(comp)
+        for n in comp:
+            grudge[n] = set(outside)
+    return grudge
+
+
+def bridge(nodes: list) -> dict:
+    """Bisects the cluster but leaves one 'bridge' node connected to both
+    halves (nemesis.clj:145-156)."""
+    nodes = list(nodes)
+    mid = len(nodes) // 2
+    bridge_node = nodes[mid]
+    a = nodes[:mid]
+    b = nodes[mid + 1:]
+    grudge = {}
+    for n in a:
+        grudge[n] = set(b)
+    for n in b:
+        grudge[n] = set(a)
+    grudge[bridge_node] = set()
+    return grudge
+
+
+def majorities_ring(nodes: list, rng: random.Random | None = None) -> dict:
+    """Every node sees a bare majority, but no two nodes see the same
+    majority: arranges nodes in a (shuffled) ring, each node talking only
+    to the nodes nearest it until a majority is visible
+    (nemesis.clj:203-277)."""
+    rng = rng or random
+    nodes = list(nodes)
+    n = len(nodes)
+    if n < 3:
+        return {node: set() for node in nodes}
+    shuffled = list(nodes)
+    rng.shuffle(shuffled)
+    m = n // 2 + 1            # bare majority, including the node itself
+    left = (m - 1) // 2       # neighbors on each side (asymmetric if even)
+    right = (m - 1) - left
+    grudge = {}
+    for i, node in enumerate(shuffled):
+        visible = {shuffled[(i + d) % n] for d in range(-left, right + 1)}
+        grudge[node] = set(shuffled) - visible
+    return grudge
+
+
+# ---------------------------------------------------------------------------
+# Partitioner nemesis
+# ---------------------------------------------------------------------------
+
+class Partitioner(Nemesis):
+    """start/stop nemesis cutting links per a grudge function
+    (nemesis.clj:158-184). grudge_fn: nodes -> grudge dict."""
+
+    def __init__(self, grudge_fn: Callable[[list], dict]):
+        self.grudge_fn = grudge_fn
+
+    def setup(self, test):
+        net = test.get("net")
+        if net is not None:
+            net.heal(test)
+        return self
+
+    def invoke(self, test, op):
+        if op.f == "start":
+            nodes = list(test["nodes"])
+            grudge = (op.value if isinstance(op.value, dict)
+                      else self.grudge_fn(nodes))
+            test["net"].drop_all(test, grudge)
+            pretty = {k: sorted(v) for k, v in grudge.items()}
+            return op.copy(value=["isolated", pretty])
+        if op.f == "stop":
+            test["net"].heal(test)
+            return op.copy(value="network healed")
+        raise ValueError(f"partitioner doesn't understand f={op.f!r}")
+
+    def teardown(self, test):
+        net = test.get("net")
+        if net is not None:
+            net.heal(test)
+
+    def fs(self):
+        return {"start", "stop"}
+
+
+def partitioner(grudge_fn) -> Partitioner:
+    return Partitioner(grudge_fn)
+
+
+def partition_halves() -> Partitioner:
+    """Cuts the network into two halves (first half vs rest)."""
+    return Partitioner(lambda nodes: complete_grudge(bisect(nodes)))
+
+
+def partition_random_halves() -> Partitioner:
+    """Cuts into two randomly chosen halves."""
+
+    def grudge(nodes):
+        nodes = list(nodes)
+        random.shuffle(nodes)
+        return complete_grudge(bisect(nodes))
+
+    return Partitioner(grudge)
+
+
+def partition_random_node() -> Partitioner:
+    """Isolates a single random node."""
+
+    def grudge(nodes):
+        return complete_grudge(split_one(random.choice(list(nodes)), nodes))
+
+    return Partitioner(grudge)
+
+
+def partition_majorities_ring() -> Partitioner:
+    """Overlapping-majorities ring partition."""
+    return Partitioner(majorities_ring)

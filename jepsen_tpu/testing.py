@@ -1,0 +1,135 @@
+"""Clusterless test fixtures: noop test, in-memory CAS register DB.
+
+Capability reference: jepsen/src/jepsen/tests.clj (noop-test 11-24,
+atom-db 26-32, atom-client 34-66). These power the reference's own
+end-to-end tests (core_test.clj:69-120) and ours.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from . import client as jclient
+from . import db as jdb
+from . import nemesis as jnemesis
+from . import os_setup
+
+
+def noop_test() -> dict:
+    """A boring test stub, basis for writing real tests
+    (tests.clj:11-24)."""
+    return {
+        "nodes": ["n1", "n2", "n3", "n4", "n5"],
+        "name": None,  # no store dir by default in unit tests
+        "os": os_setup.noop,
+        "db": jdb.noop,
+        "ssh": {"dummy": True},
+        "client": jclient.noop,
+        "nemesis": jnemesis.noop,
+        "generator": None,
+    }
+
+
+class AtomState:
+    """A lock-guarded in-memory register (the reference's atom)."""
+
+    def __init__(self, value=None):
+        self.lock = threading.Lock()
+        self.value = value
+
+
+class AtomDB(jdb.DB):
+    def __init__(self, state: AtomState):
+        self.state = state
+
+    def setup(self, test, node):
+        with self.state.lock:
+            self.state.value = 0
+
+    def teardown(self, test, node):
+        with self.state.lock:
+            self.state.value = "done"
+
+
+class AtomClient(jclient.Client):
+    """A CAS register client over shared in-memory state
+    (tests.clj:34-66)."""
+
+    def __init__(self, state: AtomState, meta_log: list | None = None,
+                 latency_s: float = 0.001):
+        self.state = state
+        self.meta_log = meta_log if meta_log is not None else []
+        self.latency_s = latency_s
+
+    def open(self, test, node):
+        self.meta_log.append("open")
+        return self
+
+    def setup(self, test):
+        self.meta_log.append("setup")
+        return self
+
+    def teardown(self, test):
+        self.meta_log.append("teardown")
+
+    def close(self, test):
+        self.meta_log.append("close")
+
+    def invoke(self, test, op):
+        # Sleep to create actual concurrency, like the reference's
+        # (Thread/sleep 1).
+        if self.latency_s:
+            time.sleep(self.latency_s)
+        if op.f == "write":
+            with self.state.lock:
+                self.state.value = op.value
+            return op.copy(type="ok")
+        if op.f == "cas":
+            cur, new = op.value
+            with self.state.lock:
+                if self.state.value == cur:
+                    self.state.value = new
+                    return op.copy(type="ok")
+            return op.copy(type="fail")
+        if op.f == "read":
+            with self.state.lock:
+                v = self.state.value
+            return op.copy(type="ok", value=v)
+        raise ValueError(f"unknown f {op.f!r}")
+
+
+class ListAppendState:
+    """In-memory strict-serializable list-append store for elle-style
+    workloads (mirrors core_test.clj's atom database for txns)."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.data: dict = {}
+
+    def apply_txn(self, txn):
+        out = []
+        with self.lock:
+            for f, k, v in txn:
+                if f == "r":
+                    out.append([f, k, list(self.data.get(k, []))])
+                elif f == "append":
+                    self.data.setdefault(k, []).append(v)
+                    out.append([f, k, v])
+                else:
+                    raise ValueError(f"unknown mop {f!r}")
+        return out
+
+
+class ListAppendClient(jclient.Client):
+    def __init__(self, state: ListAppendState, latency_s: float = 0.0005):
+        self.state = state
+        self.latency_s = latency_s
+
+    def open(self, test, node):
+        return self
+
+    def invoke(self, test, op):
+        if self.latency_s:
+            time.sleep(self.latency_s)
+        return op.copy(type="ok", value=self.state.apply_txn(op.value))

@@ -1,0 +1,62 @@
+"""Op/History data structure tests (pairing, SoA encoding, predicates)."""
+
+import numpy as np
+
+from jepsen_tpu import history as h
+from jepsen_tpu.history import History, Op
+
+
+def test_op_map_like():
+    o = h.op(type="invoke", process=0, f="write", value=3, time=5,
+             extra="x")
+    assert o["f"] == "write"
+    assert o.get("extra") == "x"
+    assert o.get("missing", 42) == 42
+    assert "extra" in o
+    o2 = o.copy(type="ok", error="nope")
+    assert o2.type == "ok"
+    assert o2.error == "nope"
+    assert o.type == "invoke"  # original unchanged
+
+
+def test_history_pairing():
+    hist = History([
+        dict(type="invoke", process=0, f="w", value=1, time=0),
+        dict(type="invoke", process=1, f="r", value=None, time=1),
+        dict(type="ok", process=0, f="w", value=1, time=2),
+        dict(type="info", process=1, f="r", value=None, time=3),
+        dict(type="invoke", process=2, f="r", value=None, time=4),
+    ])
+    pair = hist.pair_index()
+    assert pair[0] == 2 and pair[2] == 0
+    assert pair[1] == 3 and pair[3] == 1
+    assert pair[4] == -1  # never completed
+    assert hist.completion(hist[0]).type == "ok"
+    assert hist.invocation(hist[3]).index == 1
+
+
+def test_history_filters():
+    hist = History([
+        dict(type="invoke", process=0, f="w", time=0),
+        dict(type="ok", process=0, f="w", time=1),
+        dict(type="invoke", process="nemesis", f="start", time=2),
+        dict(type="info", process="nemesis", f="start", time=3),
+    ])
+    assert len(hist.client_ops()) == 2
+    assert len(hist.nemesis_ops()) == 2
+    assert len(hist.oks()) == 1
+    assert len(hist.invokes()) == 2
+
+
+def test_soa_encoding():
+    hist = History([
+        dict(type="invoke", process=0, f="w", value=1, time=10),
+        dict(type="ok", process=0, f="w", value=1, time=20),
+        dict(type="invoke", process="nemesis", f="start", time=30),
+    ])
+    soa = hist.to_soa()
+    assert soa.time.tolist() == [10, 20, 30]
+    assert soa.type.tolist() == [0, 1, 0]
+    assert soa.process[2] < 0  # named process encoded negative
+    assert soa.f_codes["w"] == 0
+    assert soa.pair.tolist() == [1, 0, -1]

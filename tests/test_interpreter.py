@@ -1,0 +1,182 @@
+"""Interpreter + clusterless end-to-end tests.
+
+Mirrors jepsen/test/jepsen/generator/interpreter_test.clj (worker
+semantics, crash -> new process) and core_test.clj (full lifecycle against
+an in-memory DB with a dummy remote).
+"""
+
+import threading
+import time
+
+import pytest
+
+from jepsen_tpu import checker as jchecker
+from jepsen_tpu import client as jclient
+from jepsen_tpu import core, interpreter
+from jepsen_tpu import generator as gen
+from jepsen_tpu import testing
+from jepsen_tpu import util
+from jepsen_tpu.checker import models
+from jepsen_tpu.history import History
+
+
+def base_test(**kw):
+    t = testing.noop_test()
+    t["concurrency"] = 4
+    t.update(kw)
+    return t
+
+
+def run_interp(test):
+    util.init_relative_time()
+    return interpreter.run(dict(test))
+
+
+def test_basic_run_produces_history():
+    n = 50
+    t = base_test(
+        client=jclient.noop,
+        generator=gen.clients(gen.limit(n, gen.repeat({"f": "write",
+                                                       "value": 1}))))
+    t = run_interp(t)
+    hist = t["history"]
+    assert len(hist) == 2 * n
+    invokes = [o for o in hist if o.type == "invoke"]
+    oks = [o for o in hist if o.type == "ok"]
+    assert len(invokes) == n
+    assert len(oks) == n
+    # Dense indices in order.
+    assert [o.index for o in hist] == list(range(2 * n))
+    # Times are monotonic.
+    times = [o.time for o in hist]
+    assert times == sorted(times)
+    # Every invocation pairs with a completion.
+    pair = hist.pair_index()
+    assert all(pair[o.index] >= 0 for o in invokes)
+
+
+class CrashingClient(jclient.Client):
+    def open(self, test, node):
+        return self
+
+    def invoke(self, test, op):
+        raise RuntimeError("kaboom")
+
+
+def test_crash_becomes_info_and_new_process():
+    n = 6
+    t = base_test(
+        concurrency=1,
+        client=CrashingClient(),
+        generator=gen.on_threads({0}, gen.limit(
+            n, gen.repeat({"f": "write", "value": 1}))))
+    t = run_interp(t)
+    hist = t["history"]
+    infos = [o for o in hist if o.type == "info"]
+    assert len(infos) == n
+    # Each crash reincarnates the process: 0, 1, 2, ... (int thread count
+    # 1 => process increments by 1 each time).
+    procs = [o.process for o in hist if o.type == "invoke"]
+    assert procs == sorted(set(procs))
+    assert len(set(procs)) == n
+
+
+def test_sleep_and_log_not_in_history():
+    t = base_test(
+        client=jclient.noop,
+        generator=gen.clients([gen.log("hello"),
+                               {"f": "write", "value": 1},
+                               gen.once(gen.sleep(0.01))]))
+    t = run_interp(t)
+    hist = t["history"]
+    assert all(o.type not in ("sleep", "log") for o in hist)
+    assert len(hist) == 2
+
+
+def test_nemesis_ops_routed():
+    class Nem(testing.jnemesis.Nemesis):
+        def __init__(self):
+            self.seen = []
+
+        def invoke(self, test, op):
+            self.seen.append(op.f)
+            return op.copy(type="info")
+
+    nem = Nem()
+    t = base_test(
+        client=jclient.noop,
+        nemesis=nem,
+        generator=gen.nemesis(
+            gen.limit(2, [{"f": "start"}, {"f": "stop"}])))
+    t = run_interp(t)
+    assert nem.seen == ["start", "stop"]
+    nem_ops = [o for o in t["history"] if o.process == "nemesis"]
+    assert len(nem_ops) == 4  # 2 invokes + 2 infos
+
+
+def test_interpreter_throughput_floor():
+    # Reference asserts >10k ops/s on the JVM (interpreter_test.clj:86-88);
+    # we assert a conservative floor to catch pathological slowdowns.
+    n = 2000
+    t = base_test(
+        concurrency=10,
+        client=jclient.noop,
+        generator=gen.clients(gen.limit(n, gen.repeat({"f": "w"}))))
+    t0 = time.monotonic()
+    t = run_interp(t)
+    dt = time.monotonic() - t0
+    assert len(t["history"]) == 2 * n
+    rate = n / dt
+    assert rate > 500, f"interpreter rate {rate:.0f} ops/s too slow"
+
+
+def test_core_run_cas_register_e2e():
+    state = testing.AtomState()
+    meta_log: list = []
+    import random
+
+    def rand_op():
+        r = random.random()
+        if r < 0.4:
+            return {"f": "read"}
+        if r < 0.7:
+            return {"f": "write", "value": random.randint(0, 4)}
+        return {"f": "cas", "value": [random.randint(0, 4),
+                                      random.randint(0, 4)]}
+
+    t = base_test(
+        nodes=["n1", "n2", "n3"],
+        concurrency=4,
+        db=testing.AtomDB(state),
+        client=testing.AtomClient(state, meta_log),
+        checker=jchecker.compose({
+            "stats": jchecker.stats(),
+            "optimism": jchecker.unbridled_optimism()}),
+        generator=gen.clients(gen.limit(60, lambda: rand_op())))
+    t = core.run(t)
+    res = t["results"]
+    assert res["valid?"] is True
+    assert res["stats"]["ok-count"] > 0
+    hist = t["history"]
+    assert len(hist) == 120
+    # Client lifecycle was respected.
+    assert "open" in meta_log and "setup" in meta_log
+    assert "teardown" in meta_log and "close" in meta_log
+
+
+def test_checker_stats_by_f():
+    ops = []
+    idx = 0
+    for i in range(10):
+        ops.append(dict(index=idx, time=i * 10, type="invoke", process=0,
+                        f="read", value=None))
+        idx += 1
+        ops.append(dict(index=idx, time=i * 10 + 5,
+                        type="ok" if i % 2 == 0 else "fail",
+                        process=0, f="read", value=1))
+        idx += 1
+    res = jchecker.check(jchecker.stats(), {}, History(ops))
+    assert res["valid?"] is True
+    assert res["ok-count"] == 5
+    assert res["fail-count"] == 5
+    assert res["by-f"]["read"]["count"] == 10

@@ -1,0 +1,87 @@
+"""Nemesis grudge math + composition tests (nemesis.clj semantics)."""
+
+import random
+
+from jepsen_tpu import nemesis as nem
+from jepsen_tpu.history import History, Op, op
+
+
+def test_bisect_and_split_one():
+    assert nem.bisect([1, 2, 3, 4, 5]) == [[1, 2], [3, 4, 5]]
+    assert nem.split_one(2, [1, 2, 3]) == [[2], [1, 3]]
+
+
+def test_complete_grudge():
+    g = nem.complete_grudge([[1, 2], [3, 4, 5]])
+    assert g[1] == {3, 4, 5}
+    assert g[3] == {1, 2}
+
+
+def test_bridge():
+    g = nem.bridge([1, 2, 3, 4, 5])
+    assert g[3] == set()          # the bridge sees everyone
+    assert g[1] == {4, 5}
+    assert g[5] == {1, 2}
+
+
+def test_majorities_ring_cuts_links_and_preserves_majorities():
+    nodes = [f"n{i}" for i in range(1, 6)]
+    g = nem.majorities_ring(nodes, rng=random.Random(7))
+    # Some links must actually be cut (regression: k formula produced an
+    # empty grudge for odd n).
+    assert any(v for v in g.values())
+    for node in nodes:
+        visible = set(nodes) - g[node]
+        assert node in visible
+        assert len(visible) == 3  # bare majority of 5
+    # No two nodes see the same majority.
+    majorities = [frozenset(set(nodes) - g[n]) for n in nodes]
+    assert len(set(majorities)) == len(nodes)
+
+
+class Recorder(nem.Nemesis):
+    def __init__(self):
+        self.seen = []
+
+    def invoke(self, test, o):
+        self.seen.append(o.f)
+        return o
+
+    def fs(self):
+        return {"go"}
+
+
+def test_compose_routes_by_fs():
+    a, b = Recorder(), Recorder()
+    c = nem.compose([({"a-go"}, nem.f_map({"a-go": "go"}, a)),
+                     ({"b-go"}, nem.f_map({"b-go": "go"}, b))])
+    c = c.setup({})
+    out = c.invoke({}, op(type="info", process="nemesis", f="a-go"))
+    assert out.f == "a-go"  # outer name restored
+    assert a.seen == ["go"]
+    assert b.seen == []
+    assert c.fs() == {"a-go", "b-go"}
+
+
+def test_compose_dict_mapping_rewrites_f():
+    a = Recorder()
+    c = nem.compose([({"kill-primary": "go"}, a)])
+    c.invoke({}, op(type="info", process="nemesis", f="kill-primary"))
+    assert a.seen == ["go"]
+    assert c.fs() == {"kill-primary"}
+
+
+def test_history_pairing_survives_filtering():
+    hist = History([
+        dict(type="invoke", process="nemesis", f="start", time=0),
+        dict(type="invoke", process=0, f="w", value=1, time=1),
+        dict(type="info", process="nemesis", f="start", time=2),
+        dict(type="ok", process=0, f="w", value=1, time=3),
+    ])
+    clients = hist.client_ops()
+    inv = clients[0]
+    comp = clients.completion(inv)
+    assert comp.type == "ok" and comp.process == 0
+    assert clients.invocation(comp).index == inv.index
+    sliced = hist[1:]
+    assert sliced.completion(sliced[0]).f == "w"
