@@ -36,6 +36,16 @@ def is_inconsistent(m) -> bool:
 
 
 class Model:
+    """A sequential datatype: step(op) -> next model | Inconsistent.
+
+    Contract for the TPU checker: step() must depend ONLY on op.f and
+    op.value — the transition tables (jepsen_tpu.tpu.encode) key distinct
+    ops by (f, value). A model that consults op.process/op.ext must set
+    `tabulable = False`, which routes checking to the object-model host
+    search instead of the device kernels."""
+
+    tabulable = True
+
     def step(self, op: Op):
         raise NotImplementedError
 

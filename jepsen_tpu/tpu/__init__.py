@@ -1,0 +1,18 @@
+"""TPU-native history analysis kernels.
+
+This package is the framework's differentiator: the search-heavy checkers
+the reference delegates to external JVM libraries (knossos for
+linearizability, elle for transactional cycle anomalies — see
+jepsen/src/jepsen/checker.clj:202-233 and
+jepsen/src/jepsen/tests/cycle/append.clj:17-27) are reimplemented as
+batched JAX programs over packed int32 tensors:
+
+- encode:   history -> entry tensors; model -> dense transition tables
+- wgl:      Wing&Gong/Lowe linearizability as a fixed-width batched
+            frontier search (host reference + device kernel)
+- elle:     dependency-edge inference + SCC cycle detection
+- ensemble: vmap/shard_map over batches of histories on a device mesh
+"""
+
+from . import encode  # noqa: F401
+from . import wgl  # noqa: F401

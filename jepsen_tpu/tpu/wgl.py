@@ -1,0 +1,674 @@
+"""Wing & Gong / Lowe linearizability checking, host + TPU.
+
+The reference delegates this to knossos (`checker.clj:202-233`:
+`knossos.competition/analysis`, `linear/analysis`, `wgl/analysis`). Both
+of knossos's searches explore *configurations* — (set of linearized ops,
+model state) pairs — memoizing visited configurations. We keep that
+algorithm but re-shape it for SIMD:
+
+- A configuration is `(p, window-bitmask, state)`: every entry below `p`
+  (in invocation order) is linearized; the uint32 mask covers entries
+  `[p, p+W)`; `state` indexes the model's pre-tabulated state space
+  (encode.py). This fixed-width encoding is exact as long as no candidate
+  entry falls `>= W` past the first unlinearized entry; when that happens
+  the kernel flags the history and the caller falls back to the unbounded
+  host search — the kernel is sound, never wrong.
+- One BFS step linearizes exactly one entry in every live configuration,
+  so the search is a `lax.while_loop` of at most `m` steps over a
+  fixed-size frontier `[B, F]`, batched over `B` histories (vmap over
+  keys/histories is the TPU win: jepsen shards its keyspace precisely so
+  histories stay short — independent.clj:2-7).
+- Candidate entries: `j` may linearize next iff
+  `inv_t[j] < min(ret_t[unlinearized])` — the standard minimal-op rule.
+  Crashed (`:info`) entries never block (`ret_t = INF`) and may either
+  take effect (a normal transition) or never happen (a "discard" action:
+  mark linearized, keep the state).
+- Deduplication is a sort + unique-compaction on packed config keys each
+  step (the memo set of the sequential algorithm becomes per-step
+  frontier dedup; BFS levels never revisit earlier levels because every
+  config at level k has exactly k entries linearized).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Sequence
+
+import numpy as np
+
+from .. import history as h
+from ..checker import models as model_mod
+from ..history import History
+from .encode import INF, Encoded, EncodingError, encode
+
+BIG = int(INF)
+
+
+# ---------------------------------------------------------------------------
+# Host search (unbounded window; correctness reference and fallback)
+# ---------------------------------------------------------------------------
+
+def search_host(enc: Encoded, witness: bool = False) -> dict:
+    """Exhaustive WGL over an Encoded history. Returns {'valid?': bool}
+    plus witness info (furthest entry reached, pending ops, states) when
+    witness=True and the history is invalid."""
+    m = enc.m
+    if m == 0:
+        return {"valid?": True}
+    inv_t = enc.inv_t
+    ret_t = enc.ret_t
+    crashed = enc.crashed
+    trans = enc.trans
+    sufmin = enc.suffix_min_ret()
+
+    # config = (p, wmask, state); wmask bit i == entry p+i linearized;
+    # bit 0 always clear (p is the first unlinearized entry).
+    s0 = enc.init_state
+    seen: set[tuple[int, int, int]] = set()
+    stack: list[tuple[int, int, int]] = [(0, 0, s0)]
+    seen.add((0, 0, s0))
+    best_p = 0
+    best_cfgs: list[tuple[int, int, int]] = [(0, 0, s0)]
+
+    while stack:
+        p, wmask, st = stack.pop()
+        if p >= m:
+            return {"valid?": True}
+        if p > best_p:
+            best_p, best_cfgs = p, []
+        if p == best_p and len(best_cfgs) < 8:
+            best_cfgs.append((p, wmask, st))
+        # min completion among unlinearized entries
+        span = wmask.bit_length()
+        min_ret = int(sufmin[min(p + span, m)])
+        for i in range(span):
+            if not (wmask >> i) & 1 and p + i < m:
+                r = int(ret_t[p + i])
+                if r < min_ret:
+                    min_ret = r
+        # candidates: unlinearized j with inv_t[j] < min_ret (inv_t sorted)
+        i = 0
+        while p + i < m and int(inv_t[p + i]) < min_ret:
+            if not (wmask >> i) & 1:
+                e = p + i
+                nmask = wmask | (1 << i)
+                # advance past the linearized prefix
+                t = _trailing_ones(nmask)
+                np_, nmask_ = p + t, nmask >> t
+                s2 = int(trans[e, st])
+                if s2 >= 0:
+                    cfg = (np_, nmask_, s2)
+                    if cfg not in seen:
+                        seen.add(cfg)
+                        stack.append(cfg)
+                if crashed[e]:
+                    cfg = (np_, nmask_, st)
+                    if cfg not in seen:
+                        seen.add(cfg)
+                        stack.append(cfg)
+            i += 1
+
+    out: dict = {"valid?": False}
+    if witness:
+        out["op"] = enc.entry_ops[best_p] if best_p < m else None
+        cfgs = []
+        for p, wmask, st in best_cfgs:
+            pending = [enc.entry_ops[p + i]
+                       for i in range(wmask.bit_length() + 1)
+                       if p + i < m and not (wmask >> i) & 1][:4]
+            cfgs.append({"model": enc.states[st], "pending": pending})
+        out["configs"] = cfgs
+        out["previous-ok"] = enc.entry_ops[best_p - 1] if best_p else None
+    return out
+
+
+def search_host_reach(enc: Encoded) -> int:
+    """Exhaustive host search returning the bitmask of model states the
+    history can end in (0 = not linearizable). Host analog of the
+    kernel's reach mode, for per-segment fallback."""
+    m = enc.m
+    if m == 0:
+        return 1 << enc.init_state
+    inv_t, ret_t, crashed, trans = (enc.inv_t, enc.ret_t, enc.crashed,
+                                    enc.trans)
+    sufmin = enc.suffix_min_ret()
+    seen = {(0, 0, enc.init_state)}
+    stack = [(0, 0, enc.init_state)]
+    out = 0
+    while stack:
+        p, wmask, st = stack.pop()
+        if p >= m:
+            out |= 1 << st
+            continue
+        span = wmask.bit_length()
+        min_ret = int(sufmin[min(p + span, m)])
+        for i in range(span):
+            if not (wmask >> i) & 1 and p + i < m:
+                r = int(ret_t[p + i])
+                if r < min_ret:
+                    min_ret = r
+        i = 0
+        while p + i < m and int(inv_t[p + i]) < min_ret:
+            if not (wmask >> i) & 1:
+                e = p + i
+                nmask = wmask | (1 << i)
+                t = _trailing_ones(nmask)
+                np_, nmask_ = p + t, nmask >> t
+                s2 = int(trans[e, st])
+                nexts = [s2] if s2 >= 0 else []
+                if crashed[e]:
+                    nexts.append(st)
+                for s_next in nexts:
+                    cfg = (np_, nmask_, s_next)
+                    if cfg not in seen:
+                        seen.add(cfg)
+                        stack.append(cfg)
+            i += 1
+    return out
+
+
+def _trailing_ones(x: int) -> int:
+    t = 0
+    while x & 1:
+        x >>= 1
+        t += 1
+    return t
+
+
+def search_host_model(model, hist: History, witness: bool = False) -> dict:
+    """Object-model WGL for models whose state space can't be tabulated
+    (mirrors knossos stepping model values directly)."""
+    from .encode import entries as entries_fn
+
+    ents = entries_fn(hist)
+    m = len(ents)
+    if m == 0:
+        return {"valid?": True}
+    inv_t = [e[0] for e in ents]
+    ret_t = [e[1] for e in ents]
+    crashed = [e[2] for e in ents]
+    ops = [e[3] for e in ents]
+    sufmin = [BIG] * (m + 1)
+    for i in range(m - 1, -1, -1):
+        sufmin[i] = min(sufmin[i + 1], ret_t[i])
+
+    seen: set = set()
+    start = (0, 0, model)
+    stack = [start]
+    seen.add((0, 0, model))
+    best_p = 0
+    best: list = [start]
+    while stack:
+        p, wmask, st = stack.pop()
+        if p >= m:
+            return {"valid?": True}
+        if p > best_p:
+            best_p, best = p, []
+        if p == best_p and len(best) < 8:
+            best.append((p, wmask, st))
+        span = wmask.bit_length()
+        min_ret = sufmin[min(p + span, m)]
+        for i in range(span):
+            if not (wmask >> i) & 1 and p + i < m:
+                min_ret = min(min_ret, ret_t[p + i])
+        i = 0
+        while p + i < m and inv_t[p + i] < min_ret:
+            if not (wmask >> i) & 1:
+                e = p + i
+                nmask = wmask | (1 << i)
+                t = _trailing_ones(nmask)
+                np_, nmask_ = p + t, nmask >> t
+                st2 = st.step(ops[e])
+                if not model_mod.is_inconsistent(st2):
+                    cfg = (np_, nmask_, st2)
+                    if cfg not in seen:
+                        seen.add(cfg)
+                        stack.append(cfg)
+                if crashed[e]:
+                    cfg = (np_, nmask_, st)
+                    if cfg not in seen:
+                        seen.add(cfg)
+                        stack.append(cfg)
+            i += 1
+    out: dict = {"valid?": False}
+    if witness:
+        out["op"] = ops[best_p] if best_p < m else None
+        out["configs"] = [{"model": st, "pending":
+                           [ops[p + i] for i in range(wmask.bit_length() + 1)
+                            if p + i < m and not (wmask >> i) & 1][:4]}
+                          for p, wmask, st in best]
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Batched device kernel
+# ---------------------------------------------------------------------------
+
+VALID = 1
+INVALID = 0
+UNKNOWN = -1
+RUNNING = -2
+
+
+def _next_pow2(n: int) -> int:
+    return 1 << max(0, (n - 1)).bit_length()
+
+
+class PackedBatch:
+    """A bucket of Encoded histories padded to common (M, S)."""
+
+    __slots__ = ("inv_t", "ret_t", "crashed", "trans", "m", "sufmin",
+                 "st0", "M", "S", "B")
+
+    def __init__(self, encs: Sequence[Encoded]):
+        B = len(encs)
+        M = max((e.m for e in encs), default=0)
+        # Bucket to powers of two so the jitted kernel compiles once per
+        # bucket rather than once per history length. Generous floors keep
+        # the number of compiled variants small; padding compute is cheap.
+        M = _next_pow2(max(M, 64))
+        S = _next_pow2(max((e.n_states for e in encs), default=1) or 1)
+        S = max(S, 8)
+        # One packed row per distinct history/segment, plus a sentinel
+        # empty row at index K that batch-padding rows point at. Search
+        # rows reference these via the kernel's row->segment indirection,
+        # so checking the same segment from S start states shares one
+        # copy of its tensors.
+        K = B + 1
+        self.B, self.M, self.S = B, M, S
+        self.inv_t = np.full((K, M), BIG, dtype=np.int32)
+        self.ret_t = np.full((K, M), BIG, dtype=np.int32)
+        self.crashed = np.zeros((K, M), dtype=bool)
+        self.trans = np.full((K, M, S), -1, dtype=np.int32)
+        self.m = np.zeros(K, dtype=np.int32)
+        self.sufmin = np.full((K, M + 1), BIG, dtype=np.int32)
+        for b, e in enumerate(encs):
+            mm = e.m
+            self.m[b] = mm
+            if mm:
+                self.inv_t[b, :mm] = e.inv_t
+                self.ret_t[b, :mm] = e.ret_t
+                self.crashed[b, :mm] = e.crashed
+                self.trans[b, :mm, :e.n_states] = e.trans
+                self.sufmin[b, :mm + 1] = e.suffix_min_ret()
+
+    def rows(self, rows: Sequence[tuple[int, int]]):
+        """(row_seg, st0) int32 arrays for (segment, start-state) search
+        rows, padded to a power of two with sentinel rows."""
+        B = len(rows)
+        Bp = _next_pow2(max(B, 1))
+        row_seg = np.full(Bp, self.B, dtype=np.int32)  # sentinel = empty
+        st0 = np.zeros(Bp, dtype=np.int32)
+        for i, (k, s) in enumerate(rows):
+            row_seg[i] = k
+            st0[i] = s
+        return row_seg, st0
+
+
+@functools.lru_cache(maxsize=None)
+def _jitted_kernel():
+    import jax
+
+    return jax.jit(_kernel, static_argnames=("W", "F", "max_iters",
+                                             "reach"))
+
+
+def _kernel(inv_t, ret_t, crashed, trans, mseg, sufmin, row_seg, st0,
+            W: int, F: int, max_iters: int, reach: bool = False):
+    """The batched WGL frontier search.
+
+    Packed data is per-*segment* ([K, M] / [K, M, S]); search rows are
+    (row_seg[b], st0[b]) pairs so many rows (e.g. S start states) share
+    one segment's tensors.
+
+    reach=False: returns int8 results [B]: 1 valid / 0 invalid /
+    -1 unknown (fall back to host); stops each history at first success.
+
+    reach=True: exhausts each history's search and returns
+    (out_mask uint32 [B] — bit s set iff final state s is reachable —
+    and unknown bool [B]); used by the segment-parallel long-history
+    path, which composes per-segment reachability. Requires S <= 32."""
+    import jax
+    import jax.numpy as jnp
+
+    B = row_seg.shape[0]
+    M = inv_t.shape[1]
+    INFi = jnp.int32(BIG)
+    u1 = jnp.uint32(1)
+    m = mseg[row_seg]                                          # [B]
+
+    def gather2(arr, idx):
+        # arr [K, M], idx [B, F, W] -> [B, F, W] via row->segment map
+        return jax.vmap(lambda sid, i: arr[sid][i])(row_seg, idx)
+
+    S = trans.shape[2]
+
+    def body(carry):
+        p, mask, st, result, out_mask, ovf, it = carry
+        live = p < INFi                                       # [B, F]
+        idxw = p[:, :, None] + jnp.arange(W, dtype=jnp.int32)  # [B,F,W]
+        inb = idxw < m[:, None, None]
+        idxc = jnp.minimum(idxw, M - 1)
+        inv_w = jnp.where(inb, gather2(inv_t, idxc), INFi)
+        ret_w = jnp.where(inb, gather2(ret_t, idxc), INFi)
+        cra_w = jnp.where(inb, gather2(crashed, idxc), False)
+        bit = (mask[:, :, None] >> jnp.arange(W, dtype=jnp.uint32)) & u1
+        unlin = inb & (bit == 0)
+        minret_w = jnp.min(jnp.where(unlin, ret_w, INFi), axis=2)  # [B,F]
+        tail_idx = jnp.minimum(p + W, M)
+        tail_min = jax.vmap(lambda sid, i: sufmin[sid][i])(
+            row_seg, tail_idx)                                 # [B,F]
+        minret = jnp.minimum(minret_w, tail_min)
+        cand = unlin & (inv_w < minret[:, :, None])           # [B,F,W]
+        # window overflow: entry p+W would itself be a candidate
+        tail_inv = jnp.where(
+            p + W < m[:, None],
+            jax.vmap(lambda sid, i: inv_t[sid][i])(
+                row_seg, jnp.minimum(p + W, M - 1)),
+            INFi)
+        cfg_ovf = live & (tail_inv < minret)                  # [B,F]
+
+        # next state per candidate: trans[seg, e, st]
+        st_nxt = jax.vmap(lambda sid, e, s: trans[sid][e, s[:, None]])(
+            row_seg, idxc, st)                                # [B,F,W]
+        apply_ok = cand & (st_nxt >= 0)
+        disc_ok = cand & cra_w
+
+        # successors [B, F, W, 2]: action 0 = apply, 1 = discard
+        nmask = mask[:, :, None] | (u1 << jnp.arange(W, dtype=jnp.uint32))
+        invm = ~nmask
+        t_ones = jnp.where(
+            invm == 0, jnp.uint32(W),
+            jax.lax.population_count((invm & (jnp.uint32(0) - invm))
+                                     - u1)).astype(jnp.int32)  # [B,F,W]
+        s_p = p[:, :, None] + t_ones
+        s_mask = jnp.where(t_ones >= W, jnp.uint32(0),
+                           nmask >> t_ones.astype(jnp.uint32))
+        running = (result == RUNNING)[:, None, None]
+        ok0 = apply_ok & live[:, :, None] & ~cfg_ovf[:, :, None] & running
+        ok1 = disc_ok & live[:, :, None] & ~cfg_ovf[:, :, None] & running
+        sp = jnp.stack([jnp.where(ok0, s_p, INFi),
+                        jnp.where(ok1, s_p, INFi)], axis=3)
+        sm = jnp.stack([jnp.where(ok0, s_mask, 0),
+                        jnp.where(ok1, s_mask, 0)], axis=3)
+        ss = jnp.stack([jnp.where(ok0, st_nxt, 0),
+                        jnp.where(ok1, st[:, :, None], 0)], axis=3)
+        N = F * W * 2
+        sp = sp.reshape(B, N)
+        sm = sm.reshape(B, N)
+        ss = ss.reshape(B, N)
+
+        # sort + dedup + compact to F slots
+        order = jnp.lexsort((ss, sm, sp), axis=-1)
+        sp = jnp.take_along_axis(sp, order, axis=1)
+        sm = jnp.take_along_axis(sm, order, axis=1)
+        ss = jnp.take_along_axis(ss, order, axis=1)
+        prev_ne = ((sp != jnp.roll(sp, 1, axis=1))
+                   | (sm != jnp.roll(sm, 1, axis=1))
+                   | (ss != jnp.roll(ss, 1, axis=1)))
+        first = jnp.zeros_like(prev_ne).at[:, 0].set(True)
+        uniq = (prev_ne | first) & (sp < INFi)
+        n_uniq = jnp.sum(uniq, axis=1)                        # [B]
+        order2 = jnp.argsort(~uniq, axis=1, stable=True)
+        sp = jnp.take_along_axis(sp, order2, axis=1)[:, :F]
+        sm = jnp.take_along_axis(sm, order2, axis=1)[:, :F]
+        ss = jnp.take_along_axis(ss, order2, axis=1)[:, :F]
+        kept = jnp.take_along_axis(uniq, order2, axis=1)[:, :F]
+        sp = jnp.where(kept, sp, INFi)
+
+        # resolution
+        done_cfg = kept & (sp >= m[:, None]) & (sp < INFi)    # [B,F]
+        succeeded = jnp.any(done_cfg, axis=1)
+        new_ovf = ovf | jnp.any(cfg_ovf & live, axis=1) | (n_uniq > F)
+        was_running = result == RUNNING
+        if reach:
+            # accumulate reachable final states; retire success configs
+            reached = jnp.any(
+                done_cfg[:, :, None]
+                & (ss[:, :, None] == jnp.arange(S)[None, None, :]),
+                axis=1)                                        # [B,S]
+            bits = jnp.sum(
+                jnp.where(reached,
+                          u1 << jnp.arange(min(S, 32), dtype=jnp.uint32)
+                          [None, :S],
+                          jnp.uint32(0)), axis=1, dtype=jnp.uint32)
+            out_mask = jnp.where(was_running, out_mask | bits, out_mask)
+            sp = jnp.where(done_cfg, INFi, sp)
+            empty = ~jnp.any(sp < INFi, axis=1)
+            result = jnp.where(
+                was_running & empty,
+                jnp.where(new_ovf, UNKNOWN, INVALID).astype(result.dtype),
+                result)
+        else:
+            empty = n_uniq == 0
+            result = jnp.where(was_running & succeeded, VALID, result)
+            result = jnp.where(
+                was_running & ~succeeded & empty,
+                jnp.where(new_ovf, UNKNOWN, INVALID).astype(result.dtype),
+                result)
+        # freeze resolved histories
+        frozen = (result != RUNNING)[:, None]
+        sp = jnp.where(frozen, INFi, sp)
+        return sp, sm, ss, result, out_mask, new_ovf, it + 1
+
+    def cond(carry):
+        _, _, _, result, _, _, it = carry
+        return jnp.any(result == RUNNING) & (it < max_iters)
+
+    p0 = jnp.full((B, F), BIG, dtype=jnp.int32).at[:, 0].set(0)
+    mask0 = jnp.zeros((B, F), dtype=jnp.uint32)
+    sts0 = jnp.zeros((B, F), dtype=jnp.int32).at[:, 0].set(st0)
+    res0 = jnp.where(m == 0, VALID, RUNNING).astype(jnp.int8)
+    ovf0 = jnp.zeros(B, dtype=bool)
+    out0 = jnp.where(m == 0, u1 << jnp.minimum(
+        st0.astype(jnp.uint32), 31), jnp.uint32(0))
+    p0 = jnp.where((res0 != RUNNING)[:, None], jnp.int32(BIG), p0)
+    carry = (p0, mask0, sts0, res0, out0, ovf0, jnp.int32(0))
+    carry = jax.lax.while_loop(cond, body, carry)
+    p, mask, st, result, out_mask, ovf, it = carry
+    result = jnp.where(result == RUNNING, UNKNOWN, result)
+    if reach:
+        unknown = (result == UNKNOWN) | ovf
+        return out_mask, unknown
+    return result
+
+
+def _launch(pb: PackedBatch, rows: Sequence[tuple[int, int]], W: int,
+            F: int, reach: bool):
+    import jax.numpy as jnp
+
+    row_seg, st0 = pb.rows(rows)
+    args = (jnp.asarray(pb.inv_t), jnp.asarray(pb.ret_t),
+            jnp.asarray(pb.crashed), jnp.asarray(pb.trans),
+            jnp.asarray(pb.m), jnp.asarray(pb.sufmin),
+            jnp.asarray(row_seg), jnp.asarray(st0))
+    return _jitted_kernel()(*args, W=W, F=F, max_iters=pb.M + 4,
+                            reach=reach)
+
+
+def check_batch(encs: Sequence[Encoded], W: int = 32,
+                F: int = 64) -> np.ndarray:
+    """Checks a batch of encoded histories on device. Returns int8 [B]
+    (VALID/INVALID/UNKNOWN). UNKNOWN means the fixed-width search couldn't
+    decide (window or frontier overflow) — fall back to search_host."""
+    pb = PackedBatch(encs)
+    rows = [(i, e.init_state) for i, e in enumerate(encs)]
+    res = _launch(pb, rows, W, F, reach=False)
+    return np.asarray(res)[:pb.B]
+
+
+def check_batch_reach(encs: Sequence[Encoded], W: int = 32,
+                      F: int = 16) -> tuple[np.ndarray, np.ndarray]:
+    """Exhaustive reachability over a batch: returns (out_mask uint32 [B]
+    — bit s set iff the whole history can linearize ending in state s —
+    and unknown bool [B]). Requires every n_states <= 32."""
+    pb = PackedBatch(encs)
+    assert pb.S <= 32, "reach mode packs states into a uint32"
+    rows = [(i, e.init_state) for i, e in enumerate(encs)]
+    out, unk = _launch(pb, rows, W, F, reach=True)
+    return np.asarray(out)[:pb.B], np.asarray(unk)[:pb.B]
+
+
+# ---------------------------------------------------------------------------
+# Segment-parallel checking of long histories
+# ---------------------------------------------------------------------------
+
+def segment_cuts(enc: Encoded, target_len: int = 2048) -> list[int]:
+    """Cut points for compositional checking. A cut before entry e is
+    sound iff every earlier entry completed before e invoked (zero ops
+    span the cut): real-time order then forces all pre-cut ops before all
+    post-cut ops in ANY linearization, so segments compose through model
+    state alone. Crashed entries (ret=INF) forbid all later cuts, which
+    degrades gracefully to bigger trailing segments."""
+    m = enc.m
+    if m == 0:
+        return [0, 0]
+    prefix_max = np.maximum.accumulate(enc.ret_t)
+    valid = np.zeros(m, dtype=bool)
+    valid[1:] = prefix_max[:-1] < enc.inv_t[1:]
+    idx = np.flatnonzero(valid)
+    cuts = [0]
+    want = target_len
+    while want < m:
+        j = np.searchsorted(idx, want)
+        if j >= len(idx):
+            break
+        e = int(idx[j])
+        cuts.append(e)
+        want = e + target_len
+    cuts.append(m)
+    return cuts
+
+
+def check_segmented(enc: Encoded, target_len: int = 2048, W: int = 32,
+                    F: int = 16, witness: bool = False) -> dict | None:
+    """Checks one long history by cutting it into segments, computing
+    per-(segment, start-state) final-state reachability in ONE batched
+    device launch, and composing reachability masks across segments.
+    Returns None when the history doesn't segment usefully (caller uses
+    the plain kernel)."""
+    if enc.n_states > 32:
+        return None
+    cuts = segment_cuts(enc, target_len)
+    K = len(cuts) - 1
+    if K < 2:
+        return None
+    S = enc.n_states
+    segs = [enc.segment(cuts[k], cuts[k + 1]) for k in range(K)]
+    # One packed copy per segment; S search rows share it via the
+    # kernel's row->segment indirection.
+    pb = PackedBatch(segs)
+    rows = [(k, s) for k in range(K) for s in range(S)]
+    out, unk = _launch(pb, rows, W, F, reach=True)
+    out = np.asarray(out)[:len(rows)]
+    unk = np.asarray(unk)[:len(rows)]
+    reach = 1 << enc.init_state
+    for k in range(K):
+        nreach = 0
+        for s in range(S):
+            if not (reach >> s) & 1:
+                continue
+            i = k * S + s
+            mask = (search_host_reach(segs[k].with_init(s)) if unk[i]
+                    else int(out[i]))
+            nreach |= mask
+        if nreach == 0:
+            res: dict = {"valid?": False, "failed-segment": k,
+                         "segment-range": [cuts[k], cuts[k + 1]]}
+            if witness:
+                for s in range(S):
+                    if (reach >> s) & 1:
+                        w = search_host(segs[k].with_init(s),
+                                        witness=True)
+                        res.update({kk: v for kk, v in w.items()
+                                    if kk != "valid?"})
+                        break
+            return res
+        reach = nreach
+    return {"valid?": True, "segments": K}
+
+
+# ---------------------------------------------------------------------------
+# Public analysis API (knossos-analysis-shaped results)
+# ---------------------------------------------------------------------------
+
+def analysis(model, hist, algorithm: str = "tpu", W: int = 32,
+             F: int = 64) -> dict:
+    """Checks a single history against a model.
+
+    algorithm: 'tpu'  — device kernel, host fallback on UNKNOWN
+               'wgl'  — host search over encoded tables
+               'model' — host search stepping model objects
+    Result mirrors knossos analysis maps: {'valid?': bool, 'op': ...,
+    'configs': [...], 'analyzer': ...}.
+    """
+    if not isinstance(hist, History):
+        hist = History(hist)
+    try:
+        enc = encode(model, hist)
+    except EncodingError:
+        out = search_host_model(model, hist, witness=True)
+        out["analyzer"] = "model"
+        return out
+
+    if algorithm == "model":
+        out = search_host_model(model, hist, witness=True)
+        out["analyzer"] = "model"
+        return out
+    if algorithm == "wgl":
+        out = search_host(enc, witness=True)
+        out["analyzer"] = "wgl"
+        return out
+
+    # Long histories: segment-parallel path (one batched launch over
+    # segments x start-states instead of m sequential frontier steps).
+    if enc.m >= 4096:
+        seg = check_segmented(enc, W=W, F=max(F // 4, 16), witness=True)
+        if seg is not None:
+            seg["analyzer"] = "tpu-segmented"
+            return seg
+
+    res = int(check_batch([enc], W=W, F=F)[0])
+    if res == VALID:
+        return {"valid?": True, "analyzer": "tpu"}
+    if res == INVALID:
+        out = search_host(enc, witness=True)  # witness extraction
+        out["analyzer"] = "tpu"
+        return out
+    out = search_host(enc, witness=True)
+    out["analyzer"] = "tpu+host-fallback"
+    return out
+
+
+def analysis_batch(model, hists: Sequence, W: int = 32,
+                   F: int = 64) -> list[dict]:
+    """Checks many histories at once (the ensemble path: one device
+    launch for the whole batch, host fallback only for UNKNOWNs)."""
+    encs = []
+    fallback: dict[int, dict] = {}
+    idx_map = []
+    for i, hh in enumerate(hists):
+        if not isinstance(hh, History):
+            hh = History(hh)
+        try:
+            encs.append(encode(model, hh))
+            idx_map.append(i)
+        except EncodingError:
+            out = search_host_model(model, hh, witness=True)
+            out["analyzer"] = "model"
+            fallback[i] = out
+    results: list[dict] = [None] * len(hists)  # type: ignore
+    for i, out in fallback.items():
+        results[i] = out
+    if encs:
+        res = check_batch(encs, W=W, F=F)
+        for j, i in enumerate(idx_map):
+            r = int(res[j])
+            if r == VALID:
+                results[i] = {"valid?": True, "analyzer": "tpu"}
+            else:
+                out = search_host(encs[j], witness=True)
+                out["analyzer"] = ("tpu" if r == INVALID
+                                   else "tpu+host-fallback")
+                results[i] = out
+    return results
