@@ -1,0 +1,70 @@
+#!/usr/bin/env python
+"""Headline benchmark: linearizability-check throughput on a 1M-event
+CAS-register history (BASELINE.md north-star config 2: check in < 60 s;
+the reference's knossos CPU checker times out at this scale).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": ops/sec checked, "unit": "ops/s",
+   "vs_baseline": speedup vs the 60 s target}
+
+Timed region: history -> encode -> device check (the full checking
+pipeline a test run would execute after the interpreter finishes).
+History generation is untimed setup. BENCH_OPS overrides the event count
+(e.g. BENCH_OPS=100000 for a smoke run on CPU).
+"""
+
+import json
+import os
+import sys
+import time
+
+
+def main():
+    n_events = int(os.environ.get("BENCH_OPS", "1000000"))
+    n_invocations = n_events // 2
+    target_s = 60.0 * (n_events / 1_000_000)  # baseline scales with size
+
+    from jepsen_tpu.checker import models
+    from jepsen_tpu.tpu import synth, wgl
+    from jepsen_tpu.tpu.encode import encode
+
+    t0 = time.time()
+    hist = synth.register_history(n_invocations, n_procs=5, seed=42)
+    n_events = len(hist)
+    gen_s = time.time() - t0
+    print(f"# generated {n_events} events in {gen_s:.1f}s",
+          file=sys.stderr)
+
+    t1 = time.time()
+    enc = encode(models.cas_register(), hist)
+    enc_s = time.time() - t1
+
+    # First check pays one-time XLA compilation (cached on disk across
+    # runs); report steady-state and note compile separately.
+    t_c = time.time()
+    wgl.check_segmented(enc, target_len=2048)
+    first_s = time.time() - t_c
+
+    t2 = time.time()
+    res = wgl.check_segmented(enc, target_len=2048)
+    if res is None:
+        res = {"valid?": bool(wgl.check_batch([enc])[0] == wgl.VALID)}
+    check_s = time.time() - t2
+    elapsed = enc_s + check_s
+    print(f"# first check (incl. compile) {first_s:.2f}s",
+          file=sys.stderr)
+
+    assert res["valid?"] is True, f"expected valid history: {res}"
+    print(f"# encode {enc_s:.2f}s  check {check_s:.2f}s  "
+          f"segments={res.get('segments')}  m={enc.m}", file=sys.stderr)
+    print(json.dumps({
+        "metric": "linearizability check throughput "
+                  f"({n_events // 1000}k-event CAS register history)",
+        "value": round(n_events / elapsed, 1),
+        "unit": "ops/s",
+        "vs_baseline": round(target_s / elapsed, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

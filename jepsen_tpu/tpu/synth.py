@@ -1,0 +1,80 @@
+"""Synthetic histories for benchmarks and compile checks.
+
+Valid-by-construction concurrent register histories: each op's effect is
+applied to the true register at a random instant inside its
+invoke/complete window, so the resulting history is linearizable by
+construction (the application order is a witness). This mirrors how the
+reference generates its perf-regression history fixture
+(jepsen/test/jepsen/perf_test.clj) but at arbitrary scale.
+"""
+
+from __future__ import annotations
+
+import random
+
+from ..history import History, op
+
+
+def register_history(n_ops: int, n_procs: int = 5, seed: int = 0,
+                     crash_p: float = 0.0, n_values: int = 5,
+                     cas_p: float = 0.25, read_p: float = 0.4) -> History:
+    """A valid CAS-register history with `n_ops` invocations (history
+    length is ~2*n_ops events). Initial register value is None."""
+    rng = random.Random(seed)
+    value = None
+    events: list = []
+    # process -> [f, v, applied?, result]
+    open_ops: dict[int, list] = {}
+    budget = n_ops
+    append = events.append
+    while budget > 0 or open_ops:
+        idle = n_procs - len(open_ops)
+        unapplied = [p for p, o in open_ops.items() if not o[2]]
+        applied = [p for p, o in open_ops.items() if o[2]]
+        r = rng.random()
+        # Prefer invoking while idle processes remain, then applying,
+        # then completing — weights keep several ops in flight.
+        if budget > 0 and idle and (r < 0.45 or not open_ops):
+            p = rng.choice([q for q in range(n_procs)
+                            if q not in open_ops])
+            r2 = rng.random()
+            if r2 < read_p:
+                f, v = "read", None
+            elif r2 < read_p + cas_p:
+                f = "cas"
+                v = [rng.randrange(n_values), rng.randrange(n_values)]
+            else:
+                f, v = "write", rng.randrange(n_values)
+            open_ops[p] = [f, v, False, None]
+            append(("invoke", p, f, v))
+            budget -= 1
+        elif unapplied and (r < 0.75 or not applied):
+            p = rng.choice(unapplied)
+            o = open_ops[p]
+            f, v = o[0], o[1]
+            if f == "read":
+                o[3] = value
+            elif f == "write":
+                value = v
+            else:
+                cur, new = v
+                if cur == value:
+                    value = new
+                    o[3] = "ok"
+                else:
+                    o[3] = "fail"
+            o[2] = True
+        elif applied:
+            p = rng.choice(applied)
+            f, v, _, result = open_ops.pop(p)
+            if crash_p and rng.random() < crash_p:
+                append(("info", p, f, v))
+            elif f == "read":
+                append(("ok", p, f, result))
+            elif f == "write":
+                append(("ok", p, f, v))
+            else:
+                append(("ok" if result == "ok" else "fail", p, f, v))
+    ops = [op(index=i, time=i, type=t, process=p, f=f, value=v)
+           for i, (t, p, f, v) in enumerate(events)]
+    return History(ops, assign_indices=False)

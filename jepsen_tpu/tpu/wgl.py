@@ -254,10 +254,21 @@ def _next_pow2(n: int) -> int:
     return 1 << max(0, (n - 1)).bit_length()
 
 
-class PackedBatch:
-    """A bucket of Encoded histories padded to common (M, S)."""
+class RangeError(Exception):
+    """History too large for the kernel's f32-exact position range;
+    callers fall back to the host search."""
 
-    __slots__ = ("inv_t", "ret_t", "crashed", "trans", "m", "sufmin",
+
+class PackedBatch:
+    """A bucket of Encoded histories padded to common (M, S).
+
+    Positions are rank-compressed per history: the kernel only compares
+    invocation/completion positions, so each history's finite positions
+    are remapped to their dense rank. Ranks stay < 2m < 2^22, keeping
+    them exact through the kernel's f32 one-hot contractions for any
+    history up to 2^21 entries (RangeError beyond)."""
+
+    __slots__ = ("inv_t", "ret_t", "trans", "m", "sufmin",
                  "st0", "M", "S", "B")
 
     def __init__(self, encs: Sequence[Encoded]):
@@ -278,19 +289,28 @@ class PackedBatch:
         self.B, self.M, self.S = B, M, S
         self.inv_t = np.full((K, M), BIG, dtype=np.int32)
         self.ret_t = np.full((K, M), BIG, dtype=np.int32)
-        self.crashed = np.zeros((K, M), dtype=bool)
         self.trans = np.full((K, M, S), -1, dtype=np.int32)
         self.m = np.zeros(K, dtype=np.int32)
         self.sufmin = np.full((K, M + 1), BIG, dtype=np.int32)
         for b, e in enumerate(encs):
             mm = e.m
             self.m[b] = mm
-            if mm:
-                self.inv_t[b, :mm] = e.inv_t
-                self.ret_t[b, :mm] = e.ret_t
-                self.crashed[b, :mm] = e.crashed
-                self.trans[b, :mm, :e.n_states] = e.trans
-                self.sufmin[b, :mm + 1] = e.suffix_min_ret()
+            if not mm:
+                continue
+            if 2 * mm >= (1 << 21):
+                raise RangeError(
+                    f"history with {mm} entries exceeds the kernel's "
+                    "f32-exact rank range")
+            fin = e.ret_t < INF
+            order = np.unique(np.concatenate([e.inv_t, e.ret_t[fin]]))
+            inv_r = np.searchsorted(order, e.inv_t).astype(np.int32)
+            ret_r = np.full(mm, BIG, dtype=np.int32)
+            ret_r[fin] = np.searchsorted(order, e.ret_t[fin])
+            self.inv_t[b, :mm] = inv_r
+            self.ret_t[b, :mm] = ret_r
+            self.trans[b, :mm, :e.n_states] = e.trans
+            self.sufmin[b, mm] = BIG
+            self.sufmin[b, :mm] = np.minimum.accumulate(ret_r[::-1])[::-1]
 
     def rows(self, rows: Sequence[tuple[int, int]]):
         """(row_seg, st0) int32 arrays for (segment, start-state) search
@@ -310,11 +330,12 @@ def _jitted_kernel():
     import jax
 
     return jax.jit(_kernel, static_argnames=("W", "F", "max_iters",
-                                             "reach"))
+                                             "reach", "debug"))
 
 
-def _kernel(inv_t, ret_t, crashed, trans, mseg, sufmin, row_seg, st0,
-            W: int, F: int, max_iters: int, reach: bool = False):
+def _kernel(inv_t, ret_t, trans, mseg, sufmin, row_seg, st0,
+            W: int, F: int, max_iters: int, reach: bool = False,
+            debug: bool = False):
     """The batched WGL frontier search.
 
     Packed data is per-*segment* ([K, M] / [K, M, S]); search rows are
@@ -332,45 +353,81 @@ def _kernel(inv_t, ret_t, crashed, trans, mseg, sufmin, row_seg, st0,
     import jax.numpy as jnp
 
     B = row_seg.shape[0]
-    M = inv_t.shape[1]
+    K, M = inv_t.shape
     INFi = jnp.int32(BIG)
     u1 = jnp.uint32(1)
     m = mseg[row_seg]                                          # [B]
-
-    def gather2(arr, idx):
-        # arr [K, M], idx [B, F, W] -> [B, F, W] via row->segment map
-        return jax.vmap(lambda sid, i: arr[sid][i])(row_seg, idx)
-
     S = trans.shape[2]
+
+    # TPU gathers cost ~8ns/element; a naive window gather dominates the
+    # whole search. Instead exploit the BFS invariant: at iteration `it`
+    # every live config has linearized exactly `it` entries, so its
+    # prefix pointer p lies in [it-W, it]. All values any config needs
+    # this step live in the contiguous entry slab [it-W, it+2W): fetch
+    # it with one dynamic_slice per table and extract per-config windows
+    # with one-hot einsum contractions on the MXU — no gathers at all.
+    # Positions are clamped to KINF = 2^22 so f32 accumulation is exact.
+    L = 3 * W + 8
+    KINF = jnp.int32(1 << 22)
+    kinf = jnp.float32(1 << 22)
+    pad_lo, pad_hi = W, max_iters + L
+    inv_p = jnp.pad(jnp.minimum(inv_t, KINF), ((0, 0), (pad_lo, pad_hi)),
+                    constant_values=1 << 22).astype(jnp.float32)
+    ret_p = jnp.pad(jnp.minimum(ret_t, KINF), ((0, 0), (pad_lo, pad_hi)),
+                    constant_values=1 << 22).astype(jnp.float32)
+    suf_p = jnp.pad(jnp.minimum(sufmin, KINF), ((0, 0), (pad_lo, pad_hi)),
+                    constant_values=1 << 22).astype(jnp.float32)
+    trans_p = jnp.pad(trans, ((0, 0), (pad_lo, pad_hi), (0, 0)),
+                      constant_values=-1).astype(jnp.float32)
+    rows_oh = (row_seg[:, None] ==
+               jnp.arange(K)[None, :]).astype(jnp.float32)     # [B,K]
+    # Positions/state codes ride through these one-hot contractions as
+    # f32 integers up to 2^22; default TPU matmul precision is bf16
+    # (8 mantissa bits) which silently rounds them. HIGHEST keeps the
+    # products exact.
+    PREC = jax.lax.Precision.HIGHEST
+    ein = functools.partial(jnp.einsum, precision=PREC)
+    iota_w = jnp.arange(W, dtype=jnp.int32)
+    iota_l = jnp.arange(L, dtype=jnp.int32)
 
     def body(carry):
         p, mask, st, result, out_mask, ovf, it = carry
         live = p < INFi                                       # [B, F]
-        idxw = p[:, :, None] + jnp.arange(W, dtype=jnp.int32)  # [B,F,W]
-        inb = idxw < m[:, None, None]
-        idxc = jnp.minimum(idxw, M - 1)
-        inv_w = jnp.where(inb, gather2(inv_t, idxc), INFi)
-        ret_w = jnp.where(inb, gather2(ret_t, idxc), INFi)
-        cra_w = jnp.where(inb, gather2(crashed, idxc), False)
+        # slab absolute entry range [it-W, it+2W+8)
+        slab_iv = jax.lax.dynamic_slice(inv_p, (0, it), (K, L))
+        slab_rt = jax.lax.dynamic_slice(ret_p, (0, it), (K, L))
+        slab_sf = jax.lax.dynamic_slice(suf_p, (0, it), (K, L))
+        slab_tr = jax.lax.dynamic_slice(trans_p, (0, it, 0), (K, L, S))
+        row_iv = ein("bk,kl->bl", rows_oh, slab_iv)           # [B, L]
+        row_rt = ein("bk,kl->bl", rows_oh, slab_rt)
+        row_sf = ein("bk,kl->bl", rows_oh, slab_sf)
+        row_tr = ein("bk,kls->bls", rows_oh, slab_tr)         # [B,L,S]
+
+        rel = p - (it - W)                                    # [B,F]
+        oh_w = ((rel[:, :, None, None] + iota_w[None, None, :, None])
+                == iota_l).astype(jnp.float32)                # [B,F,W,L]
+        inv_w = ein("bfwl,bl->bfw", oh_w, row_iv)
+        ret_w = ein("bfwl,bl->bfw", oh_w, row_rt)
+        cra_w = ret_w >= kinf
         bit = (mask[:, :, None] >> jnp.arange(W, dtype=jnp.uint32)) & u1
-        unlin = inb & (bit == 0)
-        minret_w = jnp.min(jnp.where(unlin, ret_w, INFi), axis=2)  # [B,F]
-        tail_idx = jnp.minimum(p + W, M)
-        tail_min = jax.vmap(lambda sid, i: sufmin[sid][i])(
-            row_seg, tail_idx)                                 # [B,F]
+        unlin = (bit == 0) & (inv_w < kinf)
+        minret_w = jnp.min(jnp.where(unlin, ret_w, kinf), axis=2)  # [B,F]
+        oh_t = ((rel[:, :, None] + W) == iota_l).astype(
+            jnp.float32)                                      # [B,F,L]
+        tail_min = ein("bfl,bl->bf", oh_t, row_sf)
         minret = jnp.minimum(minret_w, tail_min)
         cand = unlin & (inv_w < minret[:, :, None])           # [B,F,W]
         # window overflow: entry p+W would itself be a candidate
-        tail_inv = jnp.where(
-            p + W < m[:, None],
-            jax.vmap(lambda sid, i: inv_t[sid][i])(
-                row_seg, jnp.minimum(p + W, M - 1)),
-            INFi)
+        tail_inv = ein("bfl,bl->bf", oh_t, row_iv)
         cfg_ovf = live & (tail_inv < minret)                  # [B,F]
 
-        # next state per candidate: trans[seg, e, st]
-        st_nxt = jax.vmap(lambda sid, e, s: trans[sid][e, s[:, None]])(
-            row_seg, idxc, st)                                # [B,F,W]
+        # next state per candidate: trans[seg, e, st] via two one-hot
+        # contractions (window, then current state)
+        st_w = ein("bfwl,bls->bfws", oh_w, row_tr)            # [B,F,W,S]
+        st_oh = (st[:, :, None] == jnp.arange(S)[None, None, :]
+                 ).astype(jnp.float32)                        # [B,F,S]
+        st_nxt = ein("bfws,bfs->bfw", st_w, st_oh
+                     ).astype(jnp.int32)                      # [B,F,W]
         apply_ok = cand & (st_nxt >= 0)
         disc_ok = cand & cra_w
 
@@ -398,23 +455,21 @@ def _kernel(inv_t, ret_t, crashed, trans, mseg, sufmin, row_seg, st0,
         sm = sm.reshape(B, N)
         ss = ss.reshape(B, N)
 
-        # sort + dedup + compact to F slots
-        order = jnp.lexsort((ss, sm, sp), axis=-1)
-        sp = jnp.take_along_axis(sp, order, axis=1)
-        sm = jnp.take_along_axis(sm, order, axis=1)
-        ss = jnp.take_along_axis(ss, order, axis=1)
+        # sort + dedup + compact to F slots: two fused multi-key sorts
+        # (lax.sort with num_keys compares tuples in ONE pass — far
+        # cheaper on TPU than lexsort's per-key stable passes).
+        sp, sm, ss = jax.lax.sort((sp, sm, ss), dimension=-1, num_keys=3)
         prev_ne = ((sp != jnp.roll(sp, 1, axis=1))
                    | (sm != jnp.roll(sm, 1, axis=1))
                    | (ss != jnp.roll(ss, 1, axis=1)))
         first = jnp.zeros_like(prev_ne).at[:, 0].set(True)
         uniq = (prev_ne | first) & (sp < INFi)
         n_uniq = jnp.sum(uniq, axis=1)                        # [B]
-        order2 = jnp.argsort(~uniq, axis=1, stable=True)
-        sp = jnp.take_along_axis(sp, order2, axis=1)[:, :F]
-        sm = jnp.take_along_axis(sm, order2, axis=1)[:, :F]
-        ss = jnp.take_along_axis(ss, order2, axis=1)[:, :F]
-        kept = jnp.take_along_axis(uniq, order2, axis=1)[:, :F]
-        sp = jnp.where(kept, sp, INFi)
+        sp, sm, ss = jax.lax.sort(
+            (jnp.where(uniq, sp, INFi), jnp.where(uniq, sm, 0),
+             jnp.where(uniq, ss, 0)), dimension=-1, num_keys=3)
+        sp, sm, ss = sp[:, :F], sm[:, :F], ss[:, :F]
+        kept = sp < INFi
 
         # resolution
         done_cfg = kept & (sp >= m[:, None]) & (sp < INFi)    # [B,F]
@@ -466,6 +521,8 @@ def _kernel(inv_t, ret_t, crashed, trans, mseg, sufmin, row_seg, st0,
     carry = (p0, mask0, sts0, res0, out0, ovf0, jnp.int32(0))
     carry = jax.lax.while_loop(cond, body, carry)
     p, mask, st, result, out_mask, ovf, it = carry
+    if debug:
+        return p, mask, st, result, out_mask, ovf, it
     result = jnp.where(result == RUNNING, UNKNOWN, result)
     if reach:
         unknown = (result == UNKNOWN) | ovf
@@ -479,9 +536,9 @@ def _launch(pb: PackedBatch, rows: Sequence[tuple[int, int]], W: int,
 
     row_seg, st0 = pb.rows(rows)
     args = (jnp.asarray(pb.inv_t), jnp.asarray(pb.ret_t),
-            jnp.asarray(pb.crashed), jnp.asarray(pb.trans),
-            jnp.asarray(pb.m), jnp.asarray(pb.sufmin),
-            jnp.asarray(row_seg), jnp.asarray(st0))
+            jnp.asarray(pb.trans), jnp.asarray(pb.m),
+            jnp.asarray(pb.sufmin), jnp.asarray(row_seg),
+            jnp.asarray(st0))
     return _jitted_kernel()(*args, W=W, F=F, max_iters=pb.M + 4,
                             reach=reach)
 
@@ -498,7 +555,7 @@ def check_batch(encs: Sequence[Encoded], W: int = 32,
 
 
 def check_batch_reach(encs: Sequence[Encoded], W: int = 32,
-                      F: int = 16) -> tuple[np.ndarray, np.ndarray]:
+                      F: int = 32) -> tuple[np.ndarray, np.ndarray]:
     """Exhaustive reachability over a batch: returns (out_mask uint32 [B]
     — bit s set iff the whole history can linearize ending in state s —
     and unknown bool [B]). Requires every n_states <= 32."""
@@ -541,7 +598,7 @@ def segment_cuts(enc: Encoded, target_len: int = 2048) -> list[int]:
 
 
 def check_segmented(enc: Encoded, target_len: int = 2048, W: int = 32,
-                    F: int = 16, witness: bool = False) -> dict | None:
+                    F: int = 32, witness: bool = False) -> dict | None:
     """Checks one long history by cutting it into segments, computing
     per-(segment, start-state) final-state reachability in ONE batched
     device launch, and composing reachability masks across segments.
@@ -553,6 +610,8 @@ def check_segmented(enc: Encoded, target_len: int = 2048, W: int = 32,
     K = len(cuts) - 1
     if K < 2:
         return None
+    if 2 * max(cuts[k + 1] - cuts[k] for k in range(K)) >= (1 << 21):
+        return None  # a segment alone exceeds the kernel range
     S = enc.n_states
     segs = [enc.segment(cuts[k], cuts[k + 1]) for k in range(K)]
     # One packed copy per segment; S search rows share it via the
@@ -623,12 +682,17 @@ def analysis(model, hist, algorithm: str = "tpu", W: int = 32,
     # Long histories: segment-parallel path (one batched launch over
     # segments x start-states instead of m sequential frontier steps).
     if enc.m >= 4096:
-        seg = check_segmented(enc, W=W, F=max(F // 4, 16), witness=True)
+        seg = check_segmented(enc, W=W, F=max(F // 2, 32), witness=True)
         if seg is not None:
             seg["analyzer"] = "tpu-segmented"
             return seg
 
-    res = int(check_batch([enc], W=W, F=F)[0])
+    try:
+        res = int(check_batch([enc], W=W, F=F)[0])
+    except RangeError:
+        out = search_host(enc, witness=True)
+        out["analyzer"] = "wgl"
+        return out
     if res == VALID:
         return {"valid?": True, "analyzer": "tpu"}
     if res == INVALID:
@@ -661,7 +725,10 @@ def analysis_batch(model, hists: Sequence, W: int = 32,
     for i, out in fallback.items():
         results[i] = out
     if encs:
-        res = check_batch(encs, W=W, F=F)
+        try:
+            res = check_batch(encs, W=W, F=F)
+        except RangeError:
+            res = [UNKNOWN] * len(encs)
         for j, i in enumerate(idx_map):
             r = int(res[j])
             if r == VALID:
