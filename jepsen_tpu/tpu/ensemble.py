@@ -107,7 +107,11 @@ def analysis_batch_sharded(model, hists, mesh=None, W: int = 32,
             out["analyzer"] = "model"
             results[i] = out
     if encs:
-        res = check_batch_sharded(encs, mesh=mesh, W=W, F=F)
+        from .wgl import RangeError
+        try:
+            res = check_batch_sharded(encs, mesh=mesh, W=W, F=F)
+        except RangeError:
+            res = [wgl_mod.UNKNOWN] * len(encs)
         for j, i in enumerate(idx_map):
             r = int(res[j])
             if r == wgl_mod.VALID:

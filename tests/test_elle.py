@@ -1,0 +1,228 @@
+"""Elle-equivalent checker tests: hand-crafted anomaly fixtures for each
+Adya class plus valid end-to-end histories through the real lifecycle
+(mirrors how the reference's append.clj/wr.clj wrap elle and how
+core_test.clj runs list-append against an in-memory store)."""
+
+import itertools
+
+from jepsen_tpu import checker, core, testing
+from jepsen_tpu import generator as gen
+from jepsen_tpu.checker import cycle as cyc
+from jepsen_tpu.history import History, op
+from jepsen_tpu.tpu import elle
+from jepsen_tpu import txn as txnlib
+
+
+def T(*events):
+    """history of txn ops from (type, process, mops) tuples."""
+    return History([op(type=t, process=p, f="txn", value=m)
+                    for t, p, m in events])
+
+
+def ok_txns(*pairs):
+    """Interleave invoke/ok pairs sequentially: each pair is
+    (process, invoked_mops, completed_mops)."""
+    evs = []
+    for p, inv, okv in pairs:
+        evs.append(("invoke", p, inv))
+        evs.append(("ok", p, okv))
+    return T(*evs)
+
+
+class TestTxnAlgebra:
+    def test_ext_reads_writes(self):
+        t = [["r", "x", 1], ["w", "x", 2], ["r", "x", 2], ["r", "y", 3]]
+        assert txnlib.ext_reads(t) == {"x": 1, "y": 3}
+        assert txnlib.ext_writes(t) == {"x": 2}
+        assert txnlib.keys(t) == {"x", "y"}
+
+
+class TestListAppend:
+    def test_valid_sequential(self):
+        h = ok_txns(
+            (0, [["append", "x", 1]], [["append", "x", 1]]),
+            (1, [["r", "x", None]], [["r", "x", [1]]]),
+            (0, [["append", "x", 2]], [["append", "x", 2]]),
+            (1, [["r", "x", None]], [["r", "x", [1, 2]]]))
+        res = elle.check_list_append(h)
+        assert res["valid?"] is True, res
+
+    def test_g0_write_cycle(self):
+        # T1 and T2 append to x and y in opposite orders; a reader
+        # observes both interleavings -> ww cycle.
+        h = T(("invoke", 0, [["append", "x", 1], ["append", "y", 1]]),
+              ("invoke", 1, [["append", "x", 2], ["append", "y", 2]]),
+              ("ok", 0, [["append", "x", 1], ["append", "y", 1]]),
+              ("ok", 1, [["append", "x", 2], ["append", "y", 2]]),
+              ("invoke", 2, [["r", "x", None], ["r", "y", None]]),
+              ("ok", 2, [["r", "x", [1, 2]], ["r", "y", [2, 1]]]))
+        res = elle.check_list_append(h)
+        assert res["valid?"] is False
+        assert "G0" in res["anomaly-types"], res
+
+    def test_g1a_aborted_read(self):
+        h = ok_txns(
+            (0, [["append", "x", 9]], None),
+            (1, [["r", "x", None]], [["r", "x", [9]]]))
+        # rebuild: first txn fails
+        h = T(("invoke", 0, [["append", "x", 9]]),
+              ("fail", 0, [["append", "x", 9]]),
+              ("invoke", 1, [["r", "x", None]]),
+              ("ok", 1, [["r", "x", [9]]]))
+        res = elle.check_list_append(h)
+        assert res["valid?"] is False
+        assert "G1a" in res["anomaly-types"], res
+
+    def test_g1b_intermediate_read(self):
+        h = ok_txns(
+            (0, [["append", "x", 1], ["append", "x", 2]],
+                [["append", "x", 1], ["append", "x", 2]]),
+            (1, [["r", "x", None]], [["r", "x", [1]]]))
+        res = elle.check_list_append(h)
+        assert res["valid?"] is False
+        assert "G1b" in res["anomaly-types"], res
+
+    def test_g1c_wr_cycle(self):
+        # T1 observes T2's write and vice versa.
+        h = T(("invoke", 0, [["append", "x", 1], ["r", "y", None]]),
+              ("invoke", 1, [["append", "y", 1], ["r", "x", None]]),
+              ("ok", 0, [["append", "x", 1], ["r", "y", [1]]]),
+              ("ok", 1, [["append", "y", 1], ["r", "x", [1]]]))
+        res = elle.check_list_append(h)
+        assert res["valid?"] is False
+        assert "G1c" in res["anomaly-types"], res
+
+    def test_g_single(self):
+        # T1 reads x=[] but observes T2's y; T2 wrote x -> one rw edge.
+        h = T(("invoke", 0, [["r", "x", None], ["r", "y", None]]),
+              ("invoke", 1, [["append", "y", 1], ["append", "x", 1]]),
+              ("ok", 1, [["append", "y", 1], ["append", "x", 1]]),
+              ("ok", 0, [["r", "x", []], ["r", "y", [1]]]),
+              ("invoke", 2, [["r", "x", None]]),
+              ("ok", 2, [["r", "x", [1]]]))
+        res = elle.check_list_append(h)
+        assert res["valid?"] is False
+        assert "G-single" in res["anomaly-types"], res
+
+    def test_g2_write_skew(self):
+        h = T(("invoke", 0, [["r", "x", None], ["append", "y", 1]]),
+              ("invoke", 1, [["r", "y", None], ["append", "x", 1]]),
+              ("ok", 0, [["r", "x", []], ["append", "y", 1]]),
+              ("ok", 1, [["r", "y", []], ["append", "x", 1]]),
+              ("invoke", 2, [["r", "x", None], ["r", "y", None]]),
+              ("ok", 2, [["r", "x", [1]], ["r", "y", [1]]]))
+        res = elle.check_list_append(h)
+        assert res["valid?"] is False
+        assert "G2-item" in res["anomaly-types"], res
+
+    def test_incompatible_order(self):
+        h = ok_txns(
+            (0, [["r", "x", None]], [["r", "x", [1, 2]]]),
+            (1, [["r", "x", None]], [["r", "x", [2, 1, 3]]]))
+        res = elle.check_list_append(h)
+        assert res["valid?"] is False
+        assert "incompatible-order" in res["anomaly-types"]
+
+    def test_internal(self):
+        h = ok_txns(
+            (0, [["append", "x", 5], ["r", "x", None]],
+                [["append", "x", 5], ["r", "x", [1]]]),)
+        res = elle.check_list_append(h)
+        assert res["valid?"] is False
+        assert "internal" in res["anomaly-types"]
+
+    def test_duplicate_appends(self):
+        h = ok_txns(
+            (0, [["append", "x", 1]], [["append", "x", 1]]),
+            (1, [["append", "x", 1]], [["append", "x", 1]]))
+        res = elle.check_list_append(h)
+        assert res["valid?"] is False
+        assert "duplicate-appends" in res["anomaly-types"]
+
+
+class TestRwRegister:
+    def test_valid(self):
+        h = ok_txns(
+            (0, [["w", "x", 1]], [["w", "x", 1]]),
+            (1, [["r", "x", None]], [["r", "x", 1]]))
+        res = elle.check_rw_register(h)
+        assert res["valid?"] is True, res
+
+    def test_g1a(self):
+        h = T(("invoke", 0, [["w", "x", 7]]),
+              ("fail", 0, [["w", "x", 7]]),
+              ("invoke", 1, [["r", "x", None]]),
+              ("ok", 1, [["r", "x", 7]]))
+        res = elle.check_rw_register(h)
+        assert res["valid?"] is False
+        assert "G1a" in res["anomaly-types"]
+
+    def test_wr_cycle(self):
+        h = T(("invoke", 0, [["w", "x", 1], ["r", "y", None]]),
+              ("invoke", 1, [["w", "y", 1], ["r", "x", None]]),
+              ("ok", 0, [["w", "x", 1], ["r", "y", 1]]),
+              ("ok", 1, [["w", "y", 1], ["r", "x", 1]]))
+        res = elle.check_rw_register(h)
+        assert res["valid?"] is False
+        assert "G1c" in res["anomaly-types"], res
+
+
+class TestEndToEnd:
+    def test_list_append_lifecycle(self):
+        """Full run against the in-memory strict-serializable store,
+        checked with the elle engine (core_test.clj:69-120)."""
+        state = testing.ListAppendState()
+        g = cyc.append_gen(seed=7)
+        test = testing.noop_test()
+        test.update(
+            nodes=["n1"], concurrency=5,
+            client=testing.ListAppendClient(state),
+            checker=cyc.append_checker(),
+            generator=gen.clients(gen.limit(
+                400, lambda: next(g))))
+        test = core.run(test)
+        assert test["results"]["valid?"] is True, test["results"]
+
+    def test_scale_smoke(self):
+        """A larger sequential history stays valid and fast."""
+        g = cyc.append_gen(key_count=5, seed=3)
+        state = testing.ListAppendState()
+        evs = []
+        for i, o in zip(range(3000), g):
+            txn = o["value"]
+            res = state.apply_txn(txn)
+            evs.append(("invoke", i % 7, txn))
+            evs.append(("ok", i % 7, res))
+        res = elle.check_list_append(T(*evs))
+        assert res["valid?"] is True, res["anomaly-types"]
+        assert res["txn-count"] == 3000
+
+
+class TestReviewRegressions:
+    def test_unobservable_read_flagged(self):
+        h = ok_txns((0, [["r", "x", None]], [["r", "x", [99]]]))
+        res = elle.check_list_append(h)
+        assert res["valid?"] is False
+        assert "unobservable-read" in res["anomaly-types"]
+        h = ok_txns((0, [["r", "x", None]], [["r", "x", 99]]))
+        res = elle.check_rw_register(h)
+        assert res["valid?"] is False
+        assert "unobservable-read" in res["anomaly-types"]
+
+    def test_retry_after_fail_is_not_duplicate(self):
+        h = T(("invoke", 0, [["append", "x", 1]]),
+              ("fail", 0, [["append", "x", 1]]),
+              ("invoke", 0, [["append", "x", 1]]),
+              ("ok", 0, [["append", "x", 1]]),
+              ("invoke", 1, [["r", "x", None]]),
+              ("ok", 1, [["r", "x", [1]]]))
+        res = elle.check_list_append(h)
+        assert res["valid?"] is True, res
+
+    def test_info_append_observed_is_fine(self):
+        h = T(("invoke", 0, [["append", "x", 1]]),
+              ("info", 0, [["append", "x", 1]]),
+              ("invoke", 1, [["r", "x", None]]),
+              ("ok", 1, [["r", "x", [1]]]))
+        res = elle.check_list_append(h)
+        assert res["valid?"] is True, res

@@ -1,0 +1,91 @@
+"""Store tests: CRC'd incremental history log with crash recovery,
+three-phase saves, load/browse/delete (mirrors
+jepsen/test/jepsen/store_test.clj and store/format_test.clj)."""
+
+import json
+
+from jepsen_tpu import checker, core, store, testing
+from jepsen_tpu import generator as gen
+from jepsen_tpu.history import op
+from jepsen_tpu.store import format as fmt
+
+
+def test_history_log_roundtrip(tmp_path):
+    p = tmp_path / "history.jlog"
+    w = fmt.HistoryWriter(p)
+    ops = [op(index=i, time=i * 10, type="invoke", process=i % 3,
+              f="write", value={"k": [i, "x"]}) for i in range(50)]
+    for o in ops:
+        w.append(o)
+    back = w.read_back()
+    assert len(back) == 50
+    assert back[7].value == {"k": [7, "x"]}
+    assert back[7].process == 1
+
+
+def test_history_log_recovers_torn_tail(tmp_path):
+    p = tmp_path / "history.jlog"
+    w = fmt.HistoryWriter(p)
+    for i in range(10):
+        w.append(op(index=i, type="ok", process=0, f="read", value=i))
+    w.close()
+    size = p.stat().st_size
+    with open(p, "r+b") as f:  # tear the last record mid-payload
+        f.truncate(size - 5)
+    back = list(fmt.read_ops(p))
+    assert len(back) == 9  # torn tail dropped, rest recovered
+
+
+def test_history_log_recovers_corrupt_crc(tmp_path):
+    p = tmp_path / "history.jlog"
+    w = fmt.HistoryWriter(p)
+    for i in range(5):
+        w.append(op(index=i, type="ok", process=0, f="read", value=i))
+    w.close()
+    with open(p, "r+b") as f:
+        f.seek(-2, 2)
+        f.write(b"XX")
+    assert len(list(fmt.read_ops(p))) == 4
+
+
+def test_full_run_persists_and_loads(tmp_path):
+    state = testing.AtomState()
+    test = testing.noop_test()
+    test.update(
+        name="store-e2e", store_base=str(tmp_path),
+        nodes=["n1"], concurrency=3,
+        db=testing.AtomDB(state), client=testing.AtomClient(state),
+        checker=checker.stats(),
+        generator=gen.clients(gen.limit(30, lambda: {"f": "read"})))
+    test = core.run(test)
+    assert test["results"]["valid?"] is True
+
+    d = store.path(test)
+    assert (d / "test.json").exists()
+    assert (d / "results.json").exists()
+    assert (d / "history.jlog").exists()
+    assert (d / "jepsen.log").exists()
+
+    loaded = store.load(d)
+    assert len(loaded["history"]) == 60
+    assert loaded["results"]["valid?"] is True
+    assert loaded["name"] == "store-e2e"
+    # symlinks
+    latest = tmp_path / "store-e2e" / "latest"
+    assert latest.resolve() == d.resolve()
+    assert not (tmp_path / "current").exists()  # cleared after save-2
+
+    ts = list(store.tests(base=tmp_path))
+    assert len(ts) == 1
+    assert store.delete(base=tmp_path) == 1
+    assert list(store.tests(base=tmp_path)) == []
+
+
+def test_jsonable_degrades_gracefully():
+    class Weird:
+        def __repr__(self):
+            return "<weird>"
+
+    v = fmt.jsonable({"a": {1, 2}, "b": Weird(), "c": [op(type="ok")]})
+    json.dumps(v)  # must be serializable
+    assert v["b"] == "<weird>"
