@@ -190,24 +190,47 @@ def unhandled_exceptions() -> Checker:
 # Linearizability
 # ---------------------------------------------------------------------------
 
-def linearizable(opts: dict) -> Checker:
+class Linearizable(Checker):
     """Validates linearizability. opts: {'model': Model, 'algorithm':
-    'tpu' (default) | 'wgl'}. 'wgl' is the pure-host reference search;
-    'tpu' is the batched frontier kernel (checker.clj:202-233; the
-    reference delegates to knossos competition/linear/wgl).
-    """
-    m = opts.get("model")
-    assert m is not None, "the linearizable checker requires a model"
-    algorithm = opts.get("algorithm", "tpu")
+    'tpu' (default) | 'wgl' | 'model'}. 'wgl' is the pure-host reference
+    search; 'tpu' is the batched frontier kernel (checker.clj:202-233;
+    the reference delegates to knossos competition/linear/wgl).
 
-    def run(test, hist, copts):
-        from ..tpu import wgl
-        a = wgl.analysis(m, hist, algorithm=algorithm)
+    check_batch checks many histories in one device launch — the
+    independent checker uses it to make per-key histories the kernel's
+    batch dimension."""
+
+    def __init__(self, opts: dict):
+        self.model = opts.get("model")
+        assert self.model is not None, \
+            "the linearizable checker requires a model"
+        self.algorithm = opts.get("algorithm", "tpu")
+
+    @staticmethod
+    def _trim(a: dict) -> dict:
         a["final-paths"] = a.get("final-paths", [])[:10]
         a["configs"] = a.get("configs", [])[:10]
         return a
 
-    return _Fn(run)
+    def check(self, test, hist, opts=None):
+        from ..tpu import wgl
+
+        return self._trim(wgl.analysis(self.model, hist,
+                                       algorithm=self.algorithm))
+
+    def check_batch(self, test, hists, opts=None) -> list[dict]:
+        from ..tpu import wgl
+
+        if self.algorithm != "tpu":
+            return [self._trim(wgl.analysis(self.model, hh,
+                                            algorithm=self.algorithm))
+                    for hh in hists]
+        return [self._trim(a) for a in
+                wgl.analysis_batch(self.model, hists)]
+
+
+def linearizable(opts: dict) -> Checker:
+    return Linearizable(opts)
 
 
 # ---------------------------------------------------------------------------

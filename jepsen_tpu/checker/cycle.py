@@ -46,21 +46,22 @@ def append_gen(key_count: int = 3, min_txn_length: int = 1,
     next_val: dict[int, int] = {}
     first_key = 0
 
-    def active_keys():
-        return list(range(first_key, first_key + key_count))
-
     while True:
+        # retire the lowest key once IT fills; the window always holds
+        # key_count keys and no key exceeds its write budget
+        while next_val.get(first_key, 0) >= max_writes_per_key:
+            first_key += 1
+        keys = list(range(first_key, first_key + key_count))
         txn = []
         for _ in range(rng.randint(min_txn_length, max_txn_length)):
-            k = rng.choice(active_keys())
-            if rng.random() < 0.5:
+            k = rng.choice(keys)
+            if (rng.random() < 0.5
+                    or next_val.get(k, 0) >= max_writes_per_key):
                 txn.append(["r", k, None])
             else:
                 v = next_val.get(k, 0) + 1
                 next_val[k] = v
                 txn.append(["append", k, v])
-                if v >= max_writes_per_key:
-                    first_key += 1
         yield {"f": "txn", "value": txn}
 
 
@@ -73,15 +74,17 @@ def wr_gen(key_count: int = 3, min_txn_length: int = 1,
     next_val: dict[int, int] = {}
     first_key = 0
     while True:
+        while next_val.get(first_key, 0) >= max_writes_per_key:
+            first_key += 1
+        keys = list(range(first_key, first_key + key_count))
         txn = []
         for _ in range(rng.randint(min_txn_length, max_txn_length)):
-            k = rng.choice(range(first_key, first_key + key_count))
-            if rng.random() < 0.5:
+            k = rng.choice(keys)
+            if (rng.random() < 0.5
+                    or next_val.get(k, 0) >= max_writes_per_key):
                 txn.append(["r", k, None])
             else:
                 v = next_val.get(k, 0) + 1
                 next_val[k] = v
                 txn.append(["w", k, v])
-                if v >= max_writes_per_key:
-                    first_key += 1
         yield {"f": "txn", "value": txn}

@@ -206,25 +206,30 @@ def run(test: dict) -> dict:
         except ImportError:
             store_ctx = None
 
-    with util.with_relative_time():
-        test = control.open_sessions(test)
-        try:
-            _setup_os(test)
+    try:
+        with util.with_relative_time():
+            test = control.open_sessions(test)
             try:
-                _db_cycle(test)
+                _setup_os(test)
                 try:
-                    test = run_case(test)
-                    if store_ctx:
-                        store_ctx.save_history(test)
-                    snarf_logs(test)
+                    _db_cycle(test)
+                    try:
+                        test = run_case(test)
+                        if store_ctx:
+                            store_ctx.save_history(test)
+                        snarf_logs(test)
+                    finally:
+                        _teardown_db(test)
                 finally:
-                    _teardown_db(test)
+                    _teardown_os(test)
             finally:
-                _teardown_os(test)
-        finally:
-            control.close_sessions(test)
+                control.close_sessions(test)
 
-    test = analyze(test)
-    if store_ctx:
-        store_ctx.save_results(test)
+        test = analyze(test)
+        if store_ctx:
+            store_ctx.save_results(test)
+    finally:
+        # a crashed lifecycle must not leak the per-test log handler
+        if store_ctx:
+            store_ctx.stop(test)
     return log_results(test)

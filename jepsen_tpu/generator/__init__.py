@@ -858,6 +858,8 @@ class Limit(Generator):
         res = op(self.gen, test, ctx)
         if res is None:
             return None
+        if res[0] is PENDING:  # pending probes don't spend the budget
+            return res[0], Limit(self.remaining, res[1])
         return res[0], Limit(self.remaining - 1, res[1])
 
     def update(self, test, ctx, event):

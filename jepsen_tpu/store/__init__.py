@@ -34,7 +34,7 @@ BASE = Path("store")
 
 _SKIP_KEYS = {"history", "results", "barrier", "db", "client", "nemesis",
               "checker", "generator", "os", "remote", "sessions",
-              "history_writer", "store_dir"}
+              "history_writer", "store_dir", "_log_handler"}
 
 
 def base_dir(test: dict | None = None) -> Path:
@@ -99,6 +99,16 @@ def save_history(test: dict) -> dict:
     the interpreter); refresh the test map."""
     save_test_map(test)
     return test
+
+
+def stop(test: dict) -> None:
+    """Releases per-test resources (log handler, writer); safe to call
+    repeatedly. core.run calls this in a finally block so a crashed
+    lifecycle doesn't leak the root-logger handler."""
+    _stop_logging(test)
+    w = test.get("history_writer")
+    if w is not None:
+        w.close()
 
 
 def save_results(test: dict) -> dict:

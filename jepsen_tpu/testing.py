@@ -133,3 +133,215 @@ class ListAppendClient(jclient.Client):
         if self.latency_s:
             time.sleep(self.latency_s)
         return op.copy(type="ok", value=self.state.apply_txn(op.value))
+
+
+class KVState:
+    """Lock-guarded keyed CAS registers for independent-key workloads."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.data: dict = {}
+
+
+class KVClient(jclient.Client):
+    """Register client over keyed state; op values are (key, v) tuples
+    (the independent.clj tuple convention)."""
+
+    def __init__(self, state: KVState, latency_s: float = 0.0005):
+        self.state = state
+        self.latency_s = latency_s
+
+    def open(self, test, node):
+        return self
+
+    def invoke(self, test, op):
+        if self.latency_s:
+            time.sleep(self.latency_s)
+        k, v = op.value
+        with self.state.lock:
+            if op.f == "write":
+                self.state.data[k] = v
+                return op.copy(type="ok")
+            if op.f == "cas":
+                cur, new = v
+                if self.state.data.get(k) == cur:
+                    self.state.data[k] = new
+                    return op.copy(type="ok")
+                return op.copy(type="fail")
+            if op.f == "read":
+                return op.copy(type="ok",
+                               value=(k, self.state.data.get(k)))
+        raise ValueError(f"unknown f {op.f!r}")
+
+
+class BankState:
+    def __init__(self, accounts, initial=10):
+        self.lock = threading.Lock()
+        self.balances = {a: initial for a in accounts}
+
+
+class BankClient(jclient.Client):
+    """Serializable in-memory bank (tests/bank.clj semantics)."""
+
+    def __init__(self, state: BankState, latency_s: float = 0.0005):
+        self.state = state
+        self.latency_s = latency_s
+
+    def open(self, test, node):
+        return self
+
+    def invoke(self, test, op):
+        if self.latency_s:
+            time.sleep(self.latency_s)
+        with self.state.lock:
+            if op.f == "read":
+                return op.copy(type="ok", value=dict(self.state.balances))
+            v = op.value
+            frm, to, amt = v["from"], v["to"], v["amount"]
+            if self.state.balances.get(frm, 0) < amt:
+                return op.copy(type="fail")
+            self.state.balances[frm] -= amt
+            self.state.balances[to] = self.state.balances.get(to, 0) + amt
+            return op.copy(type="ok")
+
+
+class SetClient(jclient.Client):
+    """In-memory grow-only set; drop_every simulates lost adds."""
+
+    def __init__(self, state=None, drop_every: int = 0,
+                 latency_s: float = 0.0003):
+        self.state = state if state is not None else {"set": set(),
+                                                      "n": 0}
+        self.lock = threading.Lock()
+        self.drop_every = drop_every
+        self.latency_s = latency_s
+
+    def open(self, test, node):
+        return self
+
+    def invoke(self, test, op):
+        if self.latency_s:
+            time.sleep(self.latency_s)
+        with self.lock:
+            if op.f == "add":
+                self.state["n"] += 1
+                if self.drop_every and \
+                        self.state["n"] % self.drop_every == 0:
+                    return op.copy(type="ok")  # ack but drop: lost add
+                self.state["set"].add(op.value)
+                return op.copy(type="ok")
+            if op.f == "read":
+                return op.copy(type="ok",
+                               value=sorted(self.state["set"]))
+        raise ValueError(f"unknown f {op.f!r}")
+
+
+class QueueClient(jclient.Client):
+    """In-memory queue with optional message loss."""
+
+    def __init__(self, state=None, drop_every: int = 0,
+                 latency_s: float = 0.0003):
+        self.state = state if state is not None else {"q": [], "n": 0}
+        self.lock = threading.Lock()
+        self.drop_every = drop_every
+        self.latency_s = latency_s
+
+    def open(self, test, node):
+        return self
+
+    def invoke(self, test, op):
+        if self.latency_s:
+            time.sleep(self.latency_s)
+        with self.lock:
+            if op.f == "enqueue":
+                self.state["n"] += 1
+                if self.drop_every and \
+                        self.state["n"] % self.drop_every == 0:
+                    return op.copy(type="ok")
+                self.state["q"].append(op.value)
+                return op.copy(type="ok")
+            if op.f == "dequeue":
+                if self.state["q"]:
+                    return op.copy(type="ok",
+                                   value=self.state["q"].pop(0))
+                return op.copy(type="fail")
+            if op.f == "drain":
+                got, self.state["q"] = self.state["q"], []
+                return op.copy(type="ok", value=got)
+        raise ValueError(f"unknown f {op.f!r}")
+
+
+class CounterClient(jclient.Client):
+    def __init__(self, state=None, latency_s: float = 0.0003):
+        self.state = state if state is not None else {"v": 0}
+        self.lock = threading.Lock()
+        self.latency_s = latency_s
+
+    def open(self, test, node):
+        return self
+
+    def invoke(self, test, op):
+        if self.latency_s:
+            time.sleep(self.latency_s)
+        with self.lock:
+            if op.f == "add":
+                self.state["v"] += op.value
+                return op.copy(type="ok")
+            if op.f == "read":
+                return op.copy(type="ok", value=self.state["v"])
+        raise ValueError(f"unknown f {op.f!r}")
+
+
+class UniqueIdsClient(jclient.Client):
+    def __init__(self, state=None, dup_every: int = 0,
+                 latency_s: float = 0.0003):
+        self.state = state if state is not None else {"n": 0}
+        self.lock = threading.Lock()
+        self.dup_every = dup_every
+        self.latency_s = latency_s
+
+    def open(self, test, node):
+        return self
+
+    def invoke(self, test, op):
+        if self.latency_s:
+            time.sleep(self.latency_s)
+        with self.lock:
+            self.state["n"] += 1
+            n = self.state["n"]
+            if self.dup_every and n % self.dup_every == 0:
+                n = 1  # duplicate id
+            return op.copy(type="ok", value=n)
+
+
+class TxnClient(jclient.Client):
+    """Strict-serializable txn client over keyed lists/registers: handles
+    append/r (list-append) and w/r (rw-register) micro-ops."""
+
+    def __init__(self, state: "ListAppendState" = None,
+                 latency_s: float = 0.0003):
+        self.state = state if state is not None else ListAppendState()
+        self.latency_s = latency_s
+
+    def open(self, test, node):
+        return self
+
+    def invoke(self, test, op):
+        if self.latency_s:
+            time.sleep(self.latency_s)
+        out = []
+        with self.state.lock:
+            for f, k, v in op.value:
+                if f == "r":
+                    cur = self.state.data.get(k)
+                    out.append([f, k, list(cur) if isinstance(cur, list)
+                                else cur])
+                elif f == "append":
+                    self.state.data.setdefault(k, []).append(v)
+                    out.append([f, k, v])
+                elif f == "w":
+                    self.state.data[k] = v
+                    out.append([f, k, v])
+                else:
+                    raise ValueError(f"unknown mop {f!r}")
+        return op.copy(type="ok", value=out)

@@ -142,8 +142,6 @@ class AppendAnalysis:
 
     def _read_anomalies(self):
         for t, k, vs in self._reads():
-            own = [m[2] for m in t.mops
-                   if m[0] == "append" and m[1] == k]
             for v in vs:
                 w = self.writer.get((k, _freeze(v)))
                 if w is None:
@@ -226,7 +224,7 @@ def _order_edges(committed: list[Txn]) -> list[tuple[int, int, int]]:
         for a, b in zip(ts, ts[1:]):
             edges.append((a.i, b.i, PROC))
     by_complete = sorted(committed, key=lambda t: t.complete_pos)
-    cs = [t.complete_pos for t in by_complete]
+    cs = np.array([t.complete_pos for t in by_complete])
     for t in committed:
         j = np.searchsorted(cs, t.invoke_pos) - 1
         if j >= 0:
@@ -291,16 +289,25 @@ def _find_cycle(scc: list[int], edges) -> list[tuple[int, int, int]]:
 
 
 def _classify(cycle) -> str:
+    """Adya class from edge composition. Cycles that only close through
+    realtime/process edges get a -realtime/-process suffix (elle naming:
+    they violate strict/session variants, not serializability itself)."""
     types = {ty for _s, _d, ty in cycle}
     data = types & {WW, WR, RW}
     n_rw = sum(1 for _s, _d, ty in cycle if ty == RW)
     if data <= {WW}:
-        return "G0"
-    if RW not in types:
-        return "G1c"
-    if n_rw == 1:
-        return "G-single"
-    return "G2-item"
+        name = "G0"
+    elif RW not in data:
+        name = "G1c"
+    elif n_rw == 1:
+        name = "G-single"
+    else:
+        name = "G2-item"
+    if RT in types:
+        name += "-realtime"
+    elif PROC in types:
+        name += "-process"
+    return name
 
 
 _SERIALIZABILITY = {"G0", "G1c", "G-single", "G2-item"}

@@ -1,0 +1,29 @@
+"""Workload bundles: generator + checker pairs for the classic jepsen
+test families. Each module exposes `workload(opts) -> {"generator": ...,
+"checker": ..., ...}` mirroring how suites map workload names to
+{:generator :checker :client} bundles (e.g. tidb/src/tidb/core.clj:32-45,
+jepsen/src/jepsen/tests/bank.clj:178-191).
+"""
+
+from . import bank  # noqa: F401
+from . import counter  # noqa: F401
+from . import long_fork  # noqa: F401
+from . import queue  # noqa: F401
+from . import register  # noqa: F401
+from . import sets  # noqa: F401
+from . import txn_append  # noqa: F401
+from . import txn_wr  # noqa: F401
+from . import unique_ids  # noqa: F401
+
+REGISTRY = {
+    "bank": bank.workload,
+    "counter": counter.workload,
+    "long-fork": long_fork.workload,
+    "queue": queue.workload,
+    "register": register.workload,
+    "set": sets.workload,
+    "set-full": sets.full_workload,
+    "append": txn_append.workload,
+    "wr": txn_wr.workload,
+    "unique-ids": unique_ids.workload,
+}

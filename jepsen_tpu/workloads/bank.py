@@ -1,0 +1,81 @@
+"""Bank workload: concurrent transfers between accounts must conserve
+the total balance at every read.
+
+Capability reference: jepsen/src/jepsen/tests/bank.clj — generators
+(19-43: transfer with random from/to/amount, read), checker (56-120:
+every ok read sums to :total-amount, no negative balances unless
+:negative-balances? is set), bundle (178-191).
+"""
+
+from __future__ import annotations
+
+import random
+
+from .. import checker as chk
+from ..checker import _Fn
+
+
+def generator(accounts=None, max_transfer: int = 5, seed=None):
+    accounts = list(accounts if accounts is not None else range(8))
+    rng = random.Random(seed)
+
+    def one():
+        if rng.random() < 0.5:
+            return {"f": "read", "value": None}
+        frm, to = rng.sample(accounts, 2)
+        return {"f": "transfer",
+                "value": {"from": frm, "to": to,
+                          "amount": rng.randint(1, max_transfer)}}
+
+    return one
+
+
+def checker(opts: dict | None = None) -> chk.Checker:
+    o = dict(opts or {})
+
+    def run(test, hist, copts):
+        total = (test.get("total-amount")
+                 if isinstance(test, dict) else None)
+        if total is None:
+            total = o.get("total-amount", 0)
+        negative_ok = o.get("negative-balances?", False)
+        bad_reads = []
+        read_count = 0
+        for op in hist:
+            if op.type != "ok" or op.f != "read" or op.value is None:
+                continue
+            read_count += 1
+            balances = list(op.value.values())
+            s = sum(balances)
+            if s != total:
+                bad_reads.append({"type": "wrong-total", "expected": total,
+                                  "found": s, "op": op})
+            elif not negative_ok and any(b < 0 for b in balances):
+                bad_reads.append({"type": "negative-value",
+                                  "found": [b for b in balances if b < 0],
+                                  "op": op})
+        return {"valid?": ("unknown" if read_count == 0
+                           else not bad_reads),
+                "read-count": read_count,
+                "error-count": len(bad_reads),
+                "first-error": bad_reads[0] if bad_reads else None}
+
+    return _Fn(run)
+
+
+def workload(opts: dict | None = None) -> dict:
+    from .. import generator as gen
+
+    o = dict(opts or {})
+    accounts = o.get("accounts", list(range(8)))
+    g = generator(accounts, o.get("max-transfer", 5), o.get("seed"))
+    if o.get("ops"):
+        g = gen.limit(o["ops"], g)
+    return {
+        "accounts": accounts,
+        "total-amount": o.get("total-amount",
+                              len(accounts) * o.get("initial", 10)),
+        "generator": g,
+        "checker": chk.compose({"bank": checker(o),
+                                "stats": chk.stats()}),
+    }

@@ -1,0 +1,53 @@
+"""Linearizable register workload: per-key read/write/cas ops checked
+with the TPU linearizable checker over independent keys.
+
+Capability reference: jepsen/src/jepsen/tests/linearizable_register.clj
+(independent/checker over checker/linearizable with a cas-register
+model, per-key generators r/w/cas).
+"""
+
+from __future__ import annotations
+
+import random
+
+from .. import checker as chk
+from .. import independent
+from ..checker import models
+
+
+def r(rng):
+    return {"f": "read", "value": None}
+
+
+def w(rng, n=5):
+    return {"f": "write", "value": rng.randrange(n)}
+
+
+def cas(rng, n=5):
+    return {"f": "cas", "value": [rng.randrange(n), rng.randrange(n)]}
+
+
+def key_gen(k, ops_per_key=100, seed=None):
+    """Mixed r/w/cas ops for one key."""
+    rng = random.Random(None if seed is None else (seed, k).__hash__())
+
+    def one():
+        return rng.choice([r, w, cas])(rng)
+
+    from .. import generator as gen
+
+    return gen.limit(ops_per_key, one)
+
+
+def workload(opts: dict | None = None) -> dict:
+    o = dict(opts or {})
+    keys = o.get("keys", list(range(8)))
+    n_group = o.get("group_size", o.get("concurrency_per_key", 5))
+    ops_per_key = o.get("ops_per_key", 100)
+    seed = o.get("seed")
+    return {
+        "generator": independent.concurrent_generator(
+            n_group, keys, lambda k: key_gen(k, ops_per_key, seed)),
+        "checker": independent.checker(chk.linearizable(
+            {"model": models.cas_register(o.get("initial"))})),
+    }

@@ -89,3 +89,24 @@ def test_jsonable_degrades_gracefully():
     v = fmt.jsonable({"a": {1, 2}, "b": Weird(), "c": [op(type="ok")]})
     json.dumps(v)  # must be serializable
     assert v["b"] == "<weird>"
+
+
+def test_crashed_lifecycle_releases_log_handler(tmp_path):
+    import logging
+
+    from jepsen_tpu import db as jdb
+
+    class BoomDB(jdb.DB):
+        def setup(self, test, node):
+            raise RuntimeError("boom")
+
+    before = len(logging.getLogger().handlers)
+    test = testing.noop_test()
+    test.update(name="crash", store_base=str(tmp_path), nodes=["n1"],
+                concurrency=1, db=BoomDB(),
+                generator=gen.clients(gen.limit(1, lambda: {"f": "read"})))
+    try:
+        core.run(test)
+    except Exception:
+        pass
+    assert len(logging.getLogger().handlers) == before
