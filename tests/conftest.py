@@ -7,16 +7,34 @@ before the first `import jax` anywhere in the test session.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
-os.environ.setdefault("JAX_ENABLE_X64", "true")
-
 # Persistent compilation cache: the WGL/elle kernels compile once per
-# shape bucket; cache across test runs.
+# shape bucket; cache across test runs. Env vars must be set before the
+# `import jax` below — jax captures them at import time.
 _cache = os.path.join(os.path.dirname(__file__), "..", ".jax_cache")
 os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", _cache)
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0")
+
+# The sandbox's sitecustomize registers the real accelerator backend and
+# overrides jax_platforms after import, so the env var alone is not
+# enough: push the override through jax.config too. Opt out with
+# JEPSEN_TPU_TEST_REAL_DEVICE=1 for a real-device run (tests needing
+# more devices than the real machine has then skip via the
+# `mesh`/`devices8` fixtures).
+if os.environ.get("JEPSEN_TPU_TEST_REAL_DEVICE") != "1":
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+# sitecustomize may have imported jax at interpreter start, before any
+# of the env vars above — mirror them into jax.config so they stick.
+import jax  # noqa: E402
+
+jax.config.update("jax_compilation_cache_dir", os.path.abspath(_cache))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)

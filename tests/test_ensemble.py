@@ -1,0 +1,171 @@
+"""Mesh-sharded ensemble checking tests: the data-parallel and
+segment-parallel (reach) paths of tpu/ensemble.py on the virtual
+8-device CPU mesh set up by conftest.py.
+
+Differential strategy mirrors test_wgl.py: the sharded kernel must agree
+with the single-device kernel and the exhaustive host search on both
+valid-by-construction and corrupted histories. This is the coverage the
+driver's dryrun_multichip exercises (SURVEY §2.5: shard the batch dim
+over a 1-D Mesh; independent.clj:271-377 is the host-side analog).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from jepsen_tpu.checker import models as model
+from jepsen_tpu.history import History, op
+from jepsen_tpu.tpu import ensemble, synth, wgl
+from jepsen_tpu.tpu.encode import encode
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    import jax
+
+    devs = jax.devices()
+    if len(devs) < 8:  # real-device run (JEPSEN_TPU_TEST_REAL_DEVICE=1)
+        pytest.skip(f"needs 8 devices, have {len(devs)}")
+    return ensemble.default_mesh(8)
+
+
+def corrupt(hist):
+    """Flip one ok-read's value so the history becomes non-linearizable."""
+    ops = list(hist)
+    for i in range(len(ops) - 1, -1, -1):
+        o = ops[i]
+        if o.type == "ok" and o.f == "read" and o.value is not None:
+            ops[i] = o.copy(value=o.value + 1000)
+            return History(ops, assign_indices=False)
+    raise AssertionError("no ok read to corrupt")
+
+
+def test_default_mesh_shape(mesh):
+    assert mesh.devices.size == 8
+    assert mesh.axis_names == ("b",)
+
+
+def test_data_parallel_valid(mesh):
+    m = model.cas_register()
+    hists = [synth.register_history(32, n_procs=3, seed=i)
+             for i in range(16)]
+    encs = [encode(m, h) for h in hists]
+    res = ensemble.check_batch_sharded(encs, mesh=mesh, W=16, F=16)
+    assert res.shape == (16,)
+    assert all(int(r) == wgl.VALID for r in res)
+
+
+def test_data_parallel_mixed_validity(mesh):
+    m = model.cas_register()
+    hists = [synth.register_history(32, n_procs=3, seed=100 + i)
+             for i in range(8)]
+    bad_idx = {1, 4, 6}
+    hists = [corrupt(h) if i in bad_idx else h
+             for i, h in enumerate(hists)]
+    encs = [encode(m, h) for h in hists]
+    res = ensemble.check_batch_sharded(encs, mesh=mesh, W=16, F=32)
+    for i, (e, r) in enumerate(zip(encs, res)):
+        expect = wgl.search_host(e)["valid?"]
+        if int(r) == wgl.UNKNOWN:
+            continue  # sound: kernel may punt, never lie
+        assert (int(r) == wgl.VALID) == expect, f"history {i}"
+    # at least the corrupted ones must not come back VALID
+    for i in bad_idx:
+        assert int(res[i]) != wgl.VALID
+
+
+def test_data_parallel_matches_unsharded(mesh):
+    m = model.cas_register()
+    hists = [synth.register_history(24, n_procs=3, seed=200 + i)
+             for i in range(12)]
+    hists[3] = corrupt(hists[3])
+    encs = [encode(m, h) for h in hists]
+    sharded = ensemble.check_batch_sharded(encs, mesh=mesh, W=16, F=16)
+    plain = wgl.check_batch(encs, W=16, F=16)
+    assert list(map(int, sharded)) == list(map(int, plain))
+
+
+def test_ragged_batch_not_multiple_of_devices(mesh):
+    """Row padding: 5 histories over 8 devices still answers 5 rows."""
+    m = model.cas_register()
+    hists = [synth.register_history(16, n_procs=2, seed=300 + i)
+             for i in range(5)]
+    encs = [encode(m, h) for h in hists]
+    res = ensemble.check_batch_sharded(encs, mesh=mesh, W=16, F=16)
+    assert res.shape == (5,)
+    assert all(int(r) == wgl.VALID for r in res)
+
+
+def test_reach_segments_compose(mesh):
+    """Segment-parallel long history: sharded reach rows compose through
+    boundary states to the same verdict as the host search."""
+    m = model.cas_register()
+    hist = synth.register_history(300, n_procs=4, seed=7)
+    enc = encode(m, hist)
+    cuts = wgl.segment_cuts(enc, target_len=32)
+    K = len(cuts) - 1
+    assert K >= 2
+    segs = [enc.segment(cuts[k], cuts[k + 1]) for k in range(K)]
+    S = enc.n_states
+    rows = [(k, s) for k in range(K) for s in range(S)]
+    out, unk = ensemble.check_batch_sharded(
+        segs, mesh=mesh, W=16, F=16, reach=True, rows=rows)
+    assert out.shape == (len(rows),)
+    reach = 1 << enc.init_state
+    for k in range(K):
+        nreach = 0
+        for s in range(S):
+            if (reach >> s) & 1:
+                i = k * S + s
+                nreach |= (wgl.search_host_reach(segs[k].with_init(s))
+                           if unk[i] else int(out[i]))
+        assert nreach, f"segment {k} should stay reachable"
+        reach = nreach
+
+
+def test_reach_rows_match_host(mesh):
+    """Every (segment, start-state) reach mask the kernel resolves must
+    equal the exhaustive host reachability for that row."""
+    m = model.cas_register()
+    hist = synth.register_history(120, n_procs=3, seed=11)
+    enc = encode(m, hist)
+    cuts = wgl.segment_cuts(enc, target_len=24)
+    K = len(cuts) - 1
+    segs = [enc.segment(cuts[k], cuts[k + 1]) for k in range(K)]
+    S = enc.n_states
+    rows = [(k, s) for k in range(K) for s in range(S)]
+    out, unk = ensemble.check_batch_sharded(
+        segs, mesh=mesh, W=16, F=32, reach=True, rows=rows)
+    for i, (k, s) in enumerate(rows):
+        if unk[i]:
+            continue
+        host = wgl.search_host_reach(segs[k].with_init(s))
+        assert int(out[i]) == host, f"row {(k, s)}"
+
+
+def test_analysis_batch_sharded(mesh):
+    m = model.cas_register()
+    hists = [synth.register_history(24, n_procs=3, seed=400 + i)
+             for i in range(8)]
+    hists[2] = corrupt(hists[2])
+    res = ensemble.analysis_batch_sharded(m, hists, mesh=mesh, W=16, F=32)
+    assert len(res) == 8
+    for i, r in enumerate(res):
+        assert r["valid?"] == (i != 2)
+    assert res[2]["op"] is not None or res[2].get("configs")
+
+
+@pytest.mark.skipif(
+    os.environ.get("JEPSEN_TPU_TEST_REAL_DEVICE") == "1",
+    reason="dryrun forces the virtual CPU platform mid-session")
+def test_graft_entry_dryrun():
+    """The driver's multichip dryrun must pass end-to-end in-process."""
+    import sys
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, root)
+    try:
+        import __graft_entry__ as ge
+    finally:
+        sys.path.pop(0)
+    ge.dryrun_multichip(8)
