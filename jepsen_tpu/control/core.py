@@ -69,9 +69,17 @@ class Session:
         raise NotImplementedError
 
 
+class Lit(str):
+    """A literal shell fragment that escape() passes through untouched —
+    for pipes, redirects, and globs (the reference passes these as bare
+    Clojure symbols, which its escaping also leaves alone)."""
+
+
 def escape(arg: Any) -> str:
     """Shell-escapes a single argument. Keywords/numbers pass through as
     their string form (control/core.clj:64-101)."""
+    if isinstance(arg, Lit):
+        return str(arg)
     s = str(arg)
     if s and all(c.isalnum() or c in "-_.,/=:+@%^" for c in s):
         return s

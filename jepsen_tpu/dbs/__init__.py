@@ -1,0 +1,1 @@
+"""Reusable DB wrappers (the reference keeps these in db.clj itself)."""

@@ -1,8 +1,8 @@
 """Fault-injection nemeses.
 
-Capability reference: jepsen/src/jepsen/nemesis.clj. The core protocol and
-pure grudge/partition math live in `core`; composed packages in
-`combined`; clock manipulation in `time`.
+Capability reference: jepsen/src/jepsen/nemesis.clj. The core protocol,
+pure grudge/partition math, and process/file nemeses live in `core`;
+composed packages in `combined`; clock manipulation in `time`.
 """
 
 from .core import (Nemesis, NoopNemesis, Validate, noop, validate, invoke,
@@ -10,7 +10,9 @@ from .core import (Nemesis, NoopNemesis, Validate, noop, validate, invoke,
                    bisect, split_one, complete_grudge, bridge,
                    majorities_ring, partitioner, partition_halves,
                    partition_random_halves, partition_random_node,
-                   partition_majorities_ring)
+                   partition_majorities_ring,
+                   node_start_stopper, hammer_time, truncate_file,
+                   bitflip)
 
 __all__ = [
     "Nemesis", "NoopNemesis", "Validate", "noop", "validate", "invoke",
@@ -18,4 +20,5 @@ __all__ = [
     "bisect", "split_one", "complete_grudge", "bridge", "majorities_ring",
     "partitioner", "partition_halves", "partition_random_halves",
     "partition_random_node", "partition_majorities_ring",
+    "node_start_stopper", "hammer_time", "truncate_file", "bitflip",
 ]

@@ -9,6 +9,7 @@ application of grudges lives in jepsen_tpu.net; this module computes
 from __future__ import annotations
 
 import random
+import threading
 from typing import Any, Callable, Iterable
 
 from ..history import Op
@@ -296,6 +297,170 @@ class Partitioner(Nemesis):
 
 def partitioner(grudge_fn) -> Partitioner:
     return Partitioner(grudge_fn)
+
+
+# ---------------------------------------------------------------------------
+# Process and file nemeses (nemesis.clj:430-599)
+# ---------------------------------------------------------------------------
+
+class NodeStartStopper(Nemesis):
+    """Responds to start/stop by running start_fn/stop_fn on targeted
+    nodes with an ambient control session (nemesis.clj:453-496).
+    targeter: (test, nodes) -> node(s) or None to skip."""
+
+    def __init__(self, targeter, start_fn, stop_fn):
+        self.targeter = targeter
+        self.start_fn = start_fn
+        self.stop_fn = stop_fn
+        self._nodes = None
+        self._lock = threading.Lock()
+
+    def invoke(self, test, op):
+        from .. import control
+
+        with self._lock:
+            if op.f == "start":
+                ns = self.targeter(test, list(test["nodes"]))
+                if ns is None:
+                    return op.copy(value="no-target")
+                if not isinstance(ns, (list, tuple, set)):
+                    ns = [ns]
+                ns = list(ns)
+                if self._nodes is not None:
+                    return op.copy(
+                        value=f"nemesis already disrupting {self._nodes}")
+                self._nodes = ns
+                res = control.on_nodes(
+                    test, lambda t, n: self.start_fn(t, n), ns)
+                return op.copy(value=res)
+            if op.f == "stop":
+                if self._nodes is None:
+                    return op.copy(value="not-started")
+                res = control.on_nodes(
+                    test, lambda t, n: self.stop_fn(t, n), self._nodes)
+                self._nodes = None
+                return op.copy(value=res)
+            raise ValueError(f"unknown f {op.f!r}")
+
+    def fs(self):
+        return {"start", "stop"}
+
+
+def node_start_stopper(targeter, start_fn, stop_fn) -> NodeStartStopper:
+    return NodeStartStopper(targeter, start_fn, stop_fn)
+
+
+def _rand_node_targeter(test, nodes):
+    return random.choice(nodes)
+
+
+def hammer_time(process: str, targeter=None) -> NodeStartStopper:
+    """Pauses a named process with SIGSTOP on start, resumes with
+    SIGCONT on stop (nemesis.clj:498-513)."""
+    from .. import control
+
+    def start(test, node):
+        with control.su():
+            control.exec_("killall", "-s", "STOP", process)
+        return ["paused", process]
+
+    def stop(test, node):
+        with control.su():
+            control.exec_("killall", "-s", "CONT", process)
+        return ["resumed", process]
+
+    return NodeStartStopper(targeter or _rand_node_targeter, start, stop)
+
+
+def _resolve_target_file(file: str) -> str:
+    """file itself if it's a regular file, else a random entry of the
+    directory (nemesis.clj truncate/bitflip target selection). Probes as
+    root — DB data dirs are typically unreadable to the login user."""
+    from .. import control
+    from ..control import util as cu
+
+    with control.su():
+        if cu.file_p(file):
+            return file
+        return random.choice(cu.ls_full(file))
+
+
+class TruncateFile(Nemesis):
+    """Drops trailing bytes from files: op value is
+    {node: {'file': path-or-dir, 'drop': n-bytes}}
+    (nemesis.clj:514-548)."""
+
+    def invoke(self, test, op):
+        from .. import control
+
+        assert op.f == "truncate"
+        plan = op.value
+
+        def body(t, node):
+            spec = plan[node]
+            file, drop = spec["file"], spec["drop"]
+            assert isinstance(file, str) and isinstance(drop, int)
+            file = _resolve_target_file(file)
+            with control.su():
+                control.exec_("truncate", "-c", "-s", f"-{drop}", file)
+            return {"file": file, "drop": drop}
+
+        res = control.on_nodes(test, body, list(plan.keys()))
+        return op.copy(value=res)
+
+    def fs(self):
+        return {"truncate"}
+
+
+def truncate_file() -> TruncateFile:
+    return TruncateFile()
+
+
+class Bitflip(Nemesis):
+    """Flips random bits in files: op value is
+    {node: {'file': path-or-dir, 'probability': p}}. The reference
+    downloads a Go release binary (nemesis.clj:550-599); we compile our
+    own C tool (resources/bitflip.c) on each node instead."""
+
+    def setup(self, test):
+        import os as _os
+
+        from .. import control
+        from .time import compile_c
+
+        src = _os.path.join(_os.path.dirname(_os.path.dirname(
+            _os.path.abspath(__file__))), "resources", "bitflip.c")
+        control.on_nodes(test, lambda t, n: compile_c(src, "bitflip"))
+        return self
+
+    def invoke(self, test, op):
+        from .. import control
+
+        plan = op.value
+
+        def flip(t, node):
+            spec = plan[node]
+            file = spec.get("file")
+            if not file:
+                raise ValueError("bitflip op needs a :file")
+            file = _resolve_target_file(file)
+            probability = spec.get("probability", 0.01)
+            percent = 100 * probability
+            from .time import DIR
+            with control.su():
+                control.exec_(f"{DIR}/bitflip", "spray",
+                              f"{percent:.32f}", file)
+            return {"file": file, "probability": probability}
+
+        res = control.on_nodes(test, flip, list(plan.keys()))
+        return op.copy(value=res)
+
+    def fs(self):
+        return {"bitflip"}
+
+
+def bitflip() -> Bitflip:
+    return Bitflip()
 
 
 def partition_halves() -> Partitioner:

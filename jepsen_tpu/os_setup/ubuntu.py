@@ -1,0 +1,40 @@
+"""Ubuntu node preparation: Debian flows minus a few packages.
+
+Capability reference: jepsen/src/jepsen/os/ubuntu.clj (whole file; it
+delegates hostfile/update/install to os/debian.clj).
+"""
+
+from __future__ import annotations
+
+import logging
+
+from .. import util
+from . import OS
+from . import debian
+
+logger = logging.getLogger(__name__)
+
+PACKAGES = [
+    "apt-transport-https", "wget", "curl", "vim", "man-db", "faketime",
+    "ntpdate", "unzip", "iptables", "psmisc", "tar", "bzip2",
+    "iputils-ping", "iproute2", "rsyslog", "sudo", "logrotate",
+]
+
+
+class Ubuntu(OS):
+    packages = PACKAGES
+
+    def setup(self, test, node) -> None:
+        logger.info("%s setting up ubuntu", node)
+        debian.setup_hostfile()
+        debian.maybe_update()
+        debian.install(self.packages)
+        net = test.get("net")
+        if net is not None:
+            util.meh(lambda: net.heal(test))
+
+    def teardown(self, test, node) -> None:
+        pass
+
+
+os = Ubuntu()

@@ -13,6 +13,7 @@ import time
 from . import client as jclient
 from . import db as jdb
 from . import nemesis as jnemesis
+from . import net as jnet
 from . import os_setup
 
 
@@ -24,6 +25,7 @@ def noop_test() -> dict:
         "name": None,  # no store dir by default in unit tests
         "os": os_setup.noop,
         "db": jdb.noop,
+        "net": jnet.iptables,
         "ssh": {"dummy": True},
         "client": jclient.noop,
         "nemesis": jnemesis.noop,

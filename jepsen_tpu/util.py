@@ -199,6 +199,35 @@ def with_retry(
 # ---------------------------------------------------------------------------
 
 
+class NamedLocks:
+    """A family of locks keyed by name (jepsen.util/named-locks,
+    util.clj:855-943): with_named_lock serializes bodies per key."""
+
+    def __init__(self):
+        self._guard = threading.Lock()
+        self._locks: dict = {}
+
+    @contextmanager
+    def hold(self, name):
+        with self._guard:
+            lock = self._locks.setdefault(name, threading.Lock())
+        with lock:
+            yield
+
+
+def named_locks() -> NamedLocks:
+    return NamedLocks()
+
+
+def meh(f: Callable[[], Any]) -> Any:
+    """Calls f, returning its value, or the exception it raised instead
+    of propagating it (jepsen.util/meh)."""
+    try:
+        return f()
+    except Exception as e:  # noqa: BLE001
+        return e
+
+
 def name_str(x: Any) -> str:
     """Printable name for a thread/process id (int or str like 'nemesis')."""
     return str(x)
@@ -207,6 +236,55 @@ def name_str(x: Any) -> str:
 def majority(n: int) -> int:
     """Smallest majority of n nodes (util.clj)."""
     return n // 2 + 1
+
+
+def minority_third(n: int) -> int:
+    """Largest integer strictly less than n/3 (util.clj:95-99), for
+    byzantine-fault thresholds."""
+    return (n - 1) // 3
+
+
+def random_nonempty_subset(coll, rng: Any = None) -> list | None:
+    """A randomly selected, randomly ordered, non-empty subset; None for
+    an empty collection (util.clj:51-56)."""
+    import random as _random
+
+    rng = rng or _random
+    coll = list(coll)
+    if not coll:
+        return None
+    rng.shuffle(coll)
+    return coll[:1 + rng.randrange(len(coll))]
+
+
+def rand_distribution(dist_map: dict | None = None, rng: Any = None):
+    """Random value from a distribution spec (util.clj:140-184):
+    {'distribution': 'uniform', 'min': 0, 'max': 1024} |
+    {'distribution': 'geometric', 'p': 1e-3} |
+    {'distribution': 'one-of', 'values': [...]} |
+    {'distribution': 'weighted', 'weights': {value: weight, ...}}"""
+    import random as _random
+
+    rng = rng or _random
+    d = dict(dist_map or {})
+    kind = d.get("distribution", "uniform")
+    if kind == "uniform":
+        lo = d.get("min", 0)
+        hi = d.get("max", 2 ** 63 - 1)
+        assert lo < hi, f"invalid distribution-map: {d}"
+        return int(math.floor(lo + rng.random() * (hi - lo)))
+    if kind == "geometric":
+        p = d["p"]
+        return int(math.ceil(math.log(rng.random()) / math.log(1.0 - p)))
+    if kind == "one-of":
+        values = list(d["values"])
+        assert values, f"invalid distribution-map: {d}"
+        return rng.choice(values)
+    if kind == "weighted":
+        weights = d["weights"]
+        values = list(weights.keys())
+        return rng.choices(values, weights=[weights[v] for v in values])[0]
+    raise AssertionError(f"invalid distribution-map: {d}")
 
 
 def integer_interval_set_str(xs: Iterable[int]) -> str:

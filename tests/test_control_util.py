@@ -1,0 +1,222 @@
+"""control.util + os_setup tests: exact command lines via dummy
+sessions (the reference pattern: assert what would run on a node)."""
+
+import pytest
+
+from jepsen_tpu import control
+from jepsen_tpu.control import util as cu
+from jepsen_tpu.control.core import Action, Result
+from jepsen_tpu.control.dummy import DummyRemote
+from jepsen_tpu.os_setup import debian
+
+
+def make_session(responder=None):
+    remote = DummyRemote(responder)
+    test = {"nodes": ["n1"], "remote": remote,
+            "sessions": {"n1": remote.connect({"host": "n1"})}}
+    return test, test["sessions"]["n1"]
+
+
+def logged(sess):
+    return [(a.cmd, a.sudo) for a in sess.log if isinstance(a, Action)]
+
+
+def test_grepkill_default_signal():
+    test, sess = make_session()
+    with control.with_session(test, "n1"):
+        cu.grepkill("etcd")
+    assert ("pgrep -f --ignore-ancestors etcd | xargs "
+            "--no-run-if-empty kill -9", None) in logged(sess)
+
+
+def test_grepkill_named_signal():
+    test, sess = make_session()
+    with control.with_session(test, "n1"):
+        with control.su():
+            cu.grepkill("etcd", "stop")
+    assert ("pgrep -f --ignore-ancestors etcd | xargs "
+            "--no-run-if-empty kill -STOP", "root") in logged(sess)
+
+
+def test_start_daemon():
+    test, sess = make_session()
+    with control.with_session(test, "n1"):
+        res = cu.start_daemon(
+            {"logfile": "/var/log/db.log", "pidfile": "/run/db.pid",
+             "chdir": "/opt/db"},
+            "/opt/db/bin/db", "--port", 2379)
+    assert res == "started"
+    cmds = [c for c, _ in logged(sess)]
+    assert cmds[0].startswith("echo `date +'%Y-%m-%d %H:%M:%S'`")
+    assert cmds[0].endswith(">> /var/log/db.log")
+    assert cmds[1] == (
+        "start-stop-daemon --start --background --no-close "
+        "--make-pidfile --exec /opt/db/bin/db --pidfile /run/db.pid "
+        "--chdir /opt/db --startas /opt/db/bin/db -- --port 2379 "
+        ">> /var/log/db.log 2>&1")
+
+
+def test_start_daemon_env_and_name():
+    test, sess = make_session()
+    with control.with_session(test, "n1"):
+        cu.start_daemon(
+            {"logfile": "/l", "chdir": "/", "pidfile": None,
+             "env": {"SEEDS": "flax"}, "match_process_name": True,
+             "process_name": "dbd"},
+            "/bin/db")
+    cmds = [c for c, _ in logged(sess)]
+    assert cmds[1] == (
+        "SEEDS=flax start-stop-daemon --start --background --no-close "
+        "--exec /bin/db --name dbd --chdir / --startas /bin/db -- "
+        ">> /l 2>&1")
+
+
+def test_start_daemon_already_running():
+    def responder(node, action):
+        if "start-stop-daemon" in action.cmd:
+            return Result(exit=1, out="", err="", cmd=action.cmd)
+        return None
+
+    test, sess = make_session(responder)
+    with control.with_session(test, "n1"):
+        res = cu.start_daemon({"logfile": "/l", "chdir": "/"}, "/bin/db")
+    assert res == "already-running"
+
+
+def test_stop_daemon_by_cmd():
+    test, sess = make_session()
+    with control.with_session(test, "n1"):
+        cu.stop_daemon("etcd", "/run/etcd.pid")
+    cmds = [c for c, _ in logged(sess)]
+    assert "killall -9 -w etcd" in cmds
+    assert "rm -rf /run/etcd.pid" in cmds
+
+
+def test_write_file_uses_stdin():
+    test, sess = make_session()
+    with control.with_session(test, "n1"):
+        cu.write_file("hello\nworld", "/etc/motd")
+    acts = [a for a in sess.log if isinstance(a, Action)]
+    assert acts[0].cmd == "cat > /etc/motd"
+    assert acts[0].stdin == "hello\nworld"
+
+
+def test_cached_wget_key_is_base64():
+    import base64
+
+    url = "https://example.com/v1.2/foo.tar"
+    enc = base64.b64encode(url.encode()).decode()
+
+    def responder(node, action):
+        # "stat" existence probe fails -> must download
+        if action.cmd.startswith("stat"):
+            return Result(exit=1, out="", err="no such file",
+                          cmd=action.cmd)
+        return None
+
+    test, sess = make_session(responder)
+    with control.with_session(test, "n1"):
+        dest = cu.cached_wget(url)
+    assert dest == f"{cu.WGET_CACHE_DIR}/{enc}"
+    wgets = [a for a in sess.log if isinstance(a, Action)
+             and a.cmd.startswith("wget")]
+    assert len(wgets) == 1
+    assert f"-O {cu.WGET_CACHE_DIR}/{enc}" in wgets[0].cmd
+    assert wgets[0].dir == cu.WGET_CACHE_DIR
+
+
+def test_await_tcp_port_immediate():
+    test, sess = make_session()
+    with control.with_session(test, "n1"):
+        cu.await_tcp_port(2379, timeout_secs=1)
+    assert ("nc -z localhost 2379", None) in logged(sess)
+
+
+# ---------------------------------------------------------------------------
+# Debian OS
+# ---------------------------------------------------------------------------
+
+def debian_responder(installed=("wget", "curl")):
+    sel = "\n".join(f"{p}\tinstall" for p in installed)
+
+    def responder(node, action):
+        cmd = action.cmd
+        if cmd.startswith("cat /etc/hosts"):
+            return "127.0.0.1\tlocalhost\n10.0.0.1\tn1"
+        if cmd.startswith("date +%s"):
+            return "1000000"
+        if cmd.startswith("stat -c %Y"):
+            return "999999"  # 1s since last update: fresh
+        if cmd.startswith("dpkg --get-selections"):
+            return sel
+        return None
+
+    return responder
+
+
+def test_debian_setup_installs_missing():
+    remote = DummyRemote(debian_responder())
+    test = {"nodes": ["n1"], "remote": remote, "net": None,
+            "sessions": {"n1": remote.connect({"host": "n1"})}}
+    sess = test["sessions"]["n1"]
+    with control.with_session(test, "n1"):
+        debian.Debian().setup(test, "n1")
+    cmds = [c for c, s in logged(sess) if s == "root"]
+    installs = [c for c in cmds if "apt-get install" in c]
+    assert len(installs) == 1
+    assert installs[0].startswith(
+        "env DEBIAN_FRONTEND=noninteractive apt-get install -y "
+        "--allow-downgrades --allow-change-held-packages")
+    assert "tcpdump" in installs[0]
+    assert "wget" not in installs[0].replace("--", "")  # already there
+    # apt-get update was NOT run (cache is fresh)
+    assert not any("apt-get --allow-releaseinfo-change update" in c
+                   for c in cmds)
+
+
+def test_debian_stale_cache_updates():
+    def responder(node, action):
+        base = debian_responder()(node, action)
+        if action.cmd.startswith("stat -c %Y"):
+            return "0"  # ancient
+        return base
+
+    remote = DummyRemote(responder)
+    test = {"nodes": ["n1"], "remote": remote,
+            "sessions": {"n1": remote.connect({"host": "n1"})}}
+    with control.with_session(test, "n1"):
+        debian.maybe_update()
+    cmds = [c for c, s in logged(test["sessions"]["n1"])]
+    assert "apt-get --allow-releaseinfo-change update" in cmds
+
+
+def test_debian_install_pinned_version():
+    def responder(node, action):
+        if action.cmd.startswith("apt-cache policy"):
+            return "foo:\n  Installed: 1.0\n  Candidate: 2.0"
+        return None
+
+    remote = DummyRemote(responder)
+    test = {"nodes": ["n1"], "remote": remote,
+            "sessions": {"n1": remote.connect({"host": "n1"})}}
+    with control.with_session(test, "n1"):
+        debian.install({"foo": "2.0"})
+    cmds = [c for c, _ in logged(test["sessions"]["n1"])]
+    assert any(c.endswith("foo=2.0") for c in cmds)
+
+
+def test_debian_hostfile_rewrite():
+    def responder(node, action):
+        if action.cmd == "cat /etc/hosts":
+            return "127.0.0.1\tn1.local n1\n10.0.0.1\tn1"
+        return None
+
+    remote = DummyRemote(responder)
+    test = {"nodes": ["n1"], "remote": remote,
+            "sessions": {"n1": remote.connect({"host": "n1"})}}
+    with control.with_session(test, "n1"):
+        debian.setup_hostfile()
+    cmds = [c for c, s in logged(test["sessions"]["n1"])
+            if s == "root"]
+    assert any(c.startswith("echo ") and "> /etc/hosts" in c
+               for c in cmds)

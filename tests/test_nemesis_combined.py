@@ -1,0 +1,301 @@
+"""Combined nemesis package tests: node specs, per-fault command lines
+through dummy sessions, package composition, and a clusterless
+package-driven lifecycle."""
+
+import pytest
+
+from jepsen_tpu import control, db as jdb, generator as gen, net
+from jepsen_tpu.control.core import Action
+from jepsen_tpu.control.dummy import DummyRemote
+from jepsen_tpu.history import op
+from jepsen_tpu.nemesis import combined, core as n
+from jepsen_tpu.nemesis import time as nt
+
+
+def responder(node, action):
+    cmd = action.cmd
+    if cmd.startswith("getent ahostsv4"):
+        host = cmd.split()[-1]
+        return f"10.0.0.{host[1:]}   STREAM {host}"
+    if cmd == "ip -o link show":
+        return "1: lo: <LOOPBACK>\n2: eth0: <BROADCAST>"
+    if cmd.startswith("date +%s.%N"):
+        return "1000.5"
+    if cmd.startswith("/opt/jepsen/bump-time"):
+        return "1000.25"
+    if cmd == "cat /run/db.pid":
+        return "1234"
+    return None
+
+
+class FakeDB(jdb.DB):
+    supports_kill = True
+    supports_pause = True
+
+    def kill(self, test, node):
+        control.exec_("killall", "-9", "-w", "db")
+        return "killed"
+
+    def start(self, test, node):
+        control.exec_("start-db")
+        return "started"
+
+    def pause(self, test, node):
+        control.exec_("killall", "-s", "STOP", "db")
+        return "paused"
+
+    def resume(self, test, node):
+        control.exec_("killall", "-s", "CONT", "db")
+        return "resumed"
+
+
+@pytest.fixture()
+def test_map():
+    net.clear_ip_cache()
+    remote = DummyRemote(responder)
+    nodes = ["n1", "n2", "n3", "n4", "n5"]
+    t = {"nodes": nodes, "remote": remote, "net": net.iptables,
+         "db": FakeDB(),
+         "sessions": {x: remote.connect({"host": x}) for x in nodes}}
+    return t
+
+
+def cmds(test, node, sudo=None):
+    return [a.cmd for a in test["sessions"][node].log
+            if isinstance(a, Action)
+            and (sudo is None or a.sudo == sudo)]
+
+
+def info(f, value=None):
+    return op(type="info", process="nemesis", f=f, value=value)
+
+
+# ---------------------------------------------------------------------------
+# node specs
+# ---------------------------------------------------------------------------
+
+def test_db_nodes_specs(test_map):
+    nodes = test_map["nodes"]
+    db = test_map["db"]
+    assert combined.db_nodes(test_map, db, "all") == nodes
+    assert len(combined.db_nodes(test_map, db, "one")) == 1
+    assert len(combined.db_nodes(test_map, db, "minority")) == 2
+    assert len(combined.db_nodes(test_map, db, "majority")) == 3
+    assert len(combined.db_nodes(test_map, db, "minority-third")) == 1
+    got = combined.db_nodes(test_map, db, None)
+    assert 1 <= len(got) <= 5
+    assert combined.db_nodes(test_map, db, ["n2"]) == ["n2"]
+
+
+def test_node_specs_primaries_gated():
+    class P(jdb.DB):
+        supports_primaries = True
+
+    assert "primaries" not in combined.node_specs(jdb.DB())
+    assert "primaries" in combined.node_specs(P())
+
+
+def test_grudge_specs(test_map):
+    db = test_map["db"]
+    g = combined.grudge(test_map, db, "one")
+    isolated = [k for k, v in g.items() if len(v) == 4]
+    assert len(isolated) == 1
+    g = combined.grudge(test_map, db, "majority")
+    sizes = sorted(len(v) for v in g.values())
+    assert sizes == [2, 2, 2, 3, 3]
+    g = combined.grudge(test_map, db, "majorities-ring")
+    assert all(len(v) == 2 for v in g.values())
+    g = combined.grudge(test_map, db, "minority-third")
+    assert sorted(len(v) for v in g.values()) == [1, 1, 1, 1, 4]
+
+
+# ---------------------------------------------------------------------------
+# db (kill/pause) nemesis
+# ---------------------------------------------------------------------------
+
+def test_db_nemesis_kill_start(test_map):
+    nem = combined.DbNemesis(test_map["db"])
+    done = nem.invoke(test_map, info("kill", "all"))
+    assert done.value == {x: "killed" for x in test_map["nodes"]}
+    for x in test_map["nodes"]:
+        assert "killall -9 -w db" in cmds(test_map, x)
+    done = nem.invoke(test_map, info("start", "all"))
+    assert done.value == {x: "started" for x in test_map["nodes"]}
+
+
+def test_db_nemesis_pause_resume(test_map):
+    nem = combined.DbNemesis(test_map["db"])
+    done = nem.invoke(test_map, info("pause", ["n2"]))
+    assert done.value == {"n2": "paused"}
+    assert "killall -s STOP db" in cmds(test_map, "n2")
+    assert "killall -s STOP db" not in cmds(test_map, "n1")
+    nem.invoke(test_map, info("resume", "all"))
+    assert "killall -s CONT db" in cmds(test_map, "n1")
+
+
+def test_db_generators_flip_flop(test_map):
+    pkg_opts = {"db": test_map["db"], "faults": {"kill"},
+                "interval": 0}
+    gens = combined.db_generators(pkg_opts)
+    ctx = gen.context({"concurrency": 2, "nodes": test_map["nodes"]})
+    o, g2 = gen.op(gens["generator"], test_map, ctx)
+    assert o.f == "kill"
+    o2, _ = gen.op(g2, test_map, ctx)
+    assert o2.f == "start"
+    assert o2.value == "all"
+    assert gens["final_generator"] == [
+        {"type": "info", "f": "start", "value": "all"}]
+
+
+# ---------------------------------------------------------------------------
+# partition + packet nemeses
+# ---------------------------------------------------------------------------
+
+def test_partition_nemesis(test_map):
+    nem = combined.PartitionNemesis(test_map["db"]).setup(test_map)
+    done = nem.invoke(test_map, info("start-partition", "majority"))
+    assert done.f == "start-partition"
+    assert done.value[0] == "isolated"
+    dropped = [x for x in test_map["nodes"]
+               if any("DROP" in c for c in cmds(test_map, x))]
+    assert len(dropped) == 5
+    done = nem.invoke(test_map, info("stop-partition"))
+    assert done.f == "stop-partition"
+    assert done.value == "network healed"
+
+
+def test_packet_nemesis(test_map):
+    nem = combined.PacketNemesis(test_map["db"]).setup(test_map)
+    done = nem.invoke(
+        test_map, info("start-packet", ["all", {"delay": {}}]))
+    assert done.value[0] == "shaped"
+    got = cmds(test_map, "n1", sudo="root")
+    assert any("netem delay 50ms" in c for c in got)
+    done = nem.invoke(test_map, info("stop-packet"))
+    assert done.value[0] == "reliable"
+
+
+# ---------------------------------------------------------------------------
+# clock nemesis
+# ---------------------------------------------------------------------------
+
+def test_clock_nemesis_bump(test_map):
+    nem = nt.clock_nemesis().setup(test_map)
+    done = nem.invoke(test_map, info("bump", {"n1": 4000, "n3": -8000}))
+    offs = done["clock-offsets"]
+    assert set(offs) == {"n1", "n3"}
+    assert "/opt/jepsen/bump-time 4000" in cmds(test_map, "n1", "root")
+    assert "/opt/jepsen/bump-time -8000" in cmds(test_map, "n3", "root")
+    done = nem.invoke(test_map, info("check-offsets"))
+    assert set(done["clock-offsets"]) == set(test_map["nodes"])
+    done = nem.invoke(
+        test_map,
+        info("strobe", {"n2": {"delta": 100, "period": 10,
+                               "duration": 2}}))
+    assert "/opt/jepsen/strobe-time 100 10 2" in cmds(test_map, "n2",
+                                                      "root")
+    done = nem.invoke(test_map, info("reset", ["n4"]))
+    assert "ntpdate -b time.google.com" in cmds(test_map, "n4", "root")
+
+
+# ---------------------------------------------------------------------------
+# file corruption
+# ---------------------------------------------------------------------------
+
+def test_truncate_file_nemesis(test_map):
+    nem = n.truncate_file()
+    done = nem.invoke(test_map, info(
+        "truncate", {"n1": {"file": "/data/wal", "drop": 64}}))
+    assert done.value == {"n1": {"file": "/data/wal", "drop": 64}}
+    assert "truncate -c -s -64 /data/wal" in cmds(test_map, "n1",
+                                                  "root")
+
+
+def test_bitflip_nemesis(test_map):
+    nem = n.bitflip().setup(test_map)
+    done = nem.invoke(test_map, info(
+        "bitflip", {"n2": {"file": "/data/wal", "probability": 0.001}}))
+    assert done.value["n2"]["probability"] == 0.001
+    sprays = [c for c in cmds(test_map, "n2", "root")
+              if c.startswith("/opt/jepsen/bitflip spray")]
+    assert len(sprays) == 1
+    assert sprays[0].endswith("/data/wal")
+    assert "0.1" in sprays[0]  # 0.001 probability -> 0.1 percent
+
+
+def test_file_corruption_nemesis_spec(test_map):
+    nem = combined.FileCorruptionNemesis(test_map["db"]).setup(test_map)
+    done = nem.invoke(test_map, info(
+        "truncate", [["n1", "n2"], {"file": "/data/wal", "drop": 8}]))
+    assert set(done.value) == {"n1", "n2"}
+
+
+# ---------------------------------------------------------------------------
+# hammer time
+# ---------------------------------------------------------------------------
+
+def test_hammer_time(test_map):
+    nem = n.hammer_time("db")
+    done = nem.invoke(test_map, info("start"))
+    (node, val), = done.value.items()
+    assert val == ["paused", "db"]
+    assert "killall -s STOP db" in cmds(test_map, node, "root")
+    # second start while held: refuses
+    again = nem.invoke(test_map, info("start"))
+    assert "already disrupting" in again.value
+    done = nem.invoke(test_map, info("stop"))
+    (node2, val2), = done.value.items()
+    assert node2 == node and val2 == ["resumed", "db"]
+
+
+# ---------------------------------------------------------------------------
+# package composition + lifecycle
+# ---------------------------------------------------------------------------
+
+def test_nemesis_package_composes(test_map):
+    pkg = combined.nemesis_package(
+        {"db": test_map["db"], "interval": 0.001,
+         "faults": ["partition", "kill", "pause"]})
+    assert pkg["generator"] is not None
+    fs = pkg["nemesis"].fs()
+    assert {"start-partition", "stop-partition", "kill", "start",
+            "pause", "resume"} <= fs
+    assert pkg["final_generator"]
+    perf_names = {p[0] for p in pkg["perf"]}
+    assert {"partition", "kill", "pause"} <= perf_names
+
+
+def test_package_lifecycle_end_to_end(test_map):
+    """A package-driven nemesis schedule runs through the real
+    interpreter clusterless: ops invoked, completions recorded."""
+    from jepsen_tpu import checker, client, core, os_setup, testing
+
+    pkg = combined.nemesis_package(
+        {"db": test_map["db"], "interval": 0.001,
+         "faults": ["partition"]})
+    state = testing.AtomState()
+    test = dict(test_map)
+    test.update(
+        name=None, os=os_setup.noop, ssh={},
+        concurrency=2,
+        client=testing.AtomClient(state),
+        db=testing.AtomDB(state),
+        checker=checker.stats(),
+        nemesis=pkg["nemesis"],
+        generator=gen.nemesis(
+            gen.phases(gen.limit(4, pkg["generator"]),
+                       pkg["final_generator"]),
+            gen.time_limit(1.5, gen.stagger(
+                0.01, lambda: {"f": "read"}))))
+    test = core.run(test)
+    nem_ops = [o for o in test["history"]
+               if o.process == "nemesis" and o.type == "info"]
+    fs = {o.f for o in nem_ops}
+    assert "start-partition" in fs
+    assert "stop-partition" in fs
+    # the grudge really reached iptables on the dummy sessions
+    all_cmds = [c for x in test_map["nodes"]
+                for c in cmds(test, x)]
+    assert any("-j DROP" in c for c in all_cmds)
+    assert any(c == "iptables -F -w" for c in all_cmds)
+    assert test["results"]["valid?"] is True
