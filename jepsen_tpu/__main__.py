@@ -1,0 +1,93 @@
+"""Built-in runner: `python -m jepsen_tpu <test|test-all|serve> ...`.
+
+Drives the bundled workloads (jepsen_tpu.workloads.REGISTRY) against
+the in-memory databases in jepsen_tpu.testing when --no-ssh is given —
+the clusterless analog of each suite's core.clj -main (e.g.
+tidb/src/tidb/core.clj:47-60) — or against real nodes over SSH.
+"""
+
+from __future__ import annotations
+
+from . import checker as chk
+from . import cli, testing, workloads
+from . import generator as gen
+
+# workload name -> in-memory client factory (testing.py fixtures)
+CLIENTS = {
+    "register": lambda: testing.KVClient(testing.KVState()),
+    "bank": lambda: testing.BankClient(
+        testing.BankState(list(range(8)))),
+    "set": lambda: testing.SetClient(),
+    "set-full": lambda: testing.SetClient(),
+    "queue": lambda: testing.QueueClient(),
+    "counter": lambda: testing.CounterClient(),
+    "unique-ids": lambda: testing.UniqueIdsClient(),
+    "long-fork": lambda: testing.TxnClient(),
+    "append": lambda: testing.TxnClient(),
+    "wr": lambda: testing.TxnClient(),
+}
+
+
+def make_test(opts: dict) -> dict:
+    name = opts.get("workload", "register")
+    if name not in workloads.REGISTRY:
+        raise SystemExit(f"unknown workload {name!r}; "
+                         + cli.one_of(workloads.REGISTRY))
+    w = workloads.REGISTRY[name](
+        {"ops": opts.get("ops", 500),
+         "ops_per_key": opts.get("ops", 500) // 8 or 1,
+         # thread groups must divide concurrency (independent.clj)
+         "group_size": opts["concurrency"]})
+    test = testing.noop_test()
+    test.update(
+        name=f"{name}-demo",
+        nodes=opts["nodes"],
+        concurrency=opts["concurrency"],
+        ssh=opts["ssh"],
+        time_limit=opts.get("time_limit"),
+        client=CLIENTS[name](),
+        checker=chk.compose({"workload": w["checker"],
+                             "stats": chk.stats(),
+                             "perf": chk.perf(),
+                             "timeline": chk.timeline()}),
+        generator=gen.clients(
+            gen.time_limit(opts.get("time_limit", 60),
+                           gen.stagger(1.0 / opts.get("rate", 100),
+                                       w["generator"]))))
+    for k, v in w.items():
+        if k not in ("generator", "checker"):
+            test[k] = v
+    return test
+
+
+def make_all_tests(opts: dict):
+    names = (opts.get("workload") or "").split(",") if \
+        opts.get("workload") else list(CLIENTS)
+    for name in names:
+        o = dict(opts)
+        o["workload"] = name
+        yield make_test(o)
+
+
+def _workload_opt(p):
+    p.add_argument("--workload", default="register",
+                   help="Workload name. " + cli.one_of(CLIENTS))
+    p.add_argument("--ops", type=int, default=500,
+                   help="Rough op budget for the workload generator.")
+    p.add_argument("--rate", type=float, default=100,
+                   help="Target ops/sec across all workers.")
+    return p
+
+
+def main(argv=None) -> None:
+    commands = {}
+    commands.update(cli.single_test_cmd(make_test,
+                                        parser_fn=_workload_opt))
+    commands.update(cli.test_all_cmd(make_all_tests,
+                                     parser_fn=_workload_opt))
+    commands.update(cli.serve_cmd())
+    cli.run_cli(commands, argv)
+
+
+if __name__ == "__main__":
+    main()

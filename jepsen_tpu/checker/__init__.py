@@ -576,14 +576,22 @@ def log_file_pattern(pattern: str, filename: str) -> Checker:
 
 def perf(opts: dict | None = None) -> Checker:
     """Latency + rate graphs (checker/perf.clj); see jepsen_tpu.checker.perf."""
-    from . import perf as perf_mod
+    from ..reports.perf import latency_graph, rate_graph
 
-    return compose({"latency-graph": perf_mod.latency_graph(opts),
-                    "rate-graph": perf_mod.rate_graph(opts)})
+    return compose({"latency-graph": latency_graph(opts),
+                    "rate-graph": rate_graph(opts)})
 
 
 def clock_plot() -> Checker:
-    from . import clock as clock_mod
+    """Clock-skew plot (checker/clock.clj:14-49)."""
+    from ..reports.clock import plot as clock_plot_fn
 
     return _Fn(lambda test, hist, opts:
-               clock_mod.plot(test, hist, opts) or {"valid?": True})
+               clock_plot_fn(test, hist, opts) or {"valid?": True})
+
+
+def timeline() -> Checker:
+    """HTML timeline (checker/timeline.clj)."""
+    from ..reports.timeline import html as timeline_html
+
+    return timeline_html()

@@ -1,0 +1,274 @@
+"""Command-line runner harness.
+
+Capability reference: jepsen/src/jepsen/cli.clj — test-opt-spec
+standard flags (64-206: --node/--nodes/--nodes-file/--username/
+--password/--concurrency "2n" syntax/--test-count/--time-limit/
+--no-ssh/--leave-db-running), test-opt-fn option normalization
+(230-255), run! subcommand dispatcher with exit codes (258-335),
+serve-cmd (336-354), single-test-cmd (355-442), test-all run/summary/
+exit (443-530).
+
+Exit codes mirror the reference: 0 pass, 1 invalid, 2 unknown,
+254 usage error, 255 crash.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import sys
+import time
+from typing import Callable
+
+from . import util
+
+logger = logging.getLogger(__name__)
+
+DEFAULT_NODES = ["n1", "n2", "n3", "n4", "n5"]
+
+
+def one_of(coll) -> str:
+    names = sorted(coll.keys() if isinstance(coll, dict) else coll)
+    return "Must be one of " + ", ".join(str(n) for n in names)
+
+
+def _concurrency(s: str) -> str:
+    import re
+
+    if not re.fullmatch(r"\d+n?", s):
+        raise argparse.ArgumentTypeError(
+            "Must be an integer, optionally followed by n.")
+    return s
+
+
+def add_test_opts(p: argparse.ArgumentParser) -> argparse.ArgumentParser:
+    """The standard test flags (cli.clj test-opt-spec, 64-206)."""
+    p.add_argument("-n", "--node", action="append", dest="node",
+                   metavar="HOSTNAME", default=None,
+                   help="Node to run the test on; repeatable.")
+    p.add_argument("--nodes", metavar="NODE_LIST",
+                   help="Comma-separated list of node hostnames.")
+    p.add_argument("--nodes-file", metavar="FILENAME",
+                   help="File of node hostnames, one per line.")
+    p.add_argument("--username", default="root",
+                   help="Username for logins")
+    p.add_argument("--password", default="root",
+                   help="Password for sudo access")
+    p.add_argument("--strict-host-key-checking", action="store_true",
+                   help="Whether to check host keys")
+    p.add_argument("--no-ssh", action="store_true",
+                   help="Don't establish SSH connections (dummy remote).")
+    p.add_argument("--ssh-private-key", metavar="FILE",
+                   help="Path to an SSH identity file")
+    p.add_argument("--concurrency", default="1n", type=_concurrency,
+                   help="Worker count; an integer, optionally followed "
+                        "by n to multiply by the node count (e.g. 3n).")
+    p.add_argument("--leave-db-running", action="store_true",
+                   help="Leave the database running after the test.")
+    p.add_argument("--test-count", type=int, default=1,
+                   help="How many times to repeat the test.")
+    p.add_argument("--time-limit", type=int, default=60,
+                   help="Test duration excluding setup/teardown, secs.")
+    return p
+
+
+def test_opt_fn(options: argparse.Namespace) -> dict:
+    """Normalizes parsed options into a test-options dict
+    (cli.clj test-opt-fn: parse-nodes, parse-concurrency,
+    rename-ssh-options)."""
+    o = vars(options).copy()
+    if o.get("nodes_file"):
+        with open(o["nodes_file"]) as f:
+            nodes = [ln.strip() for ln in f if ln.strip()]
+    elif o.get("nodes"):
+        nodes = [n.strip() for n in o["nodes"].split(",") if n.strip()]
+    elif o.get("node"):
+        nodes = list(o["node"])
+    else:
+        nodes = list(DEFAULT_NODES)
+    o["nodes"] = nodes
+    o["concurrency"] = util.coll_scaled(o.get("concurrency", "1n"),
+                                        len(nodes))
+    o["ssh"] = {
+        "username": o.pop("username", "root"),
+        "password": o.pop("password", "root"),
+        "strict_host_key_checking": o.pop("strict_host_key_checking",
+                                          False),
+        "private_key_path": o.pop("ssh_private_key", None),
+        "dummy": o.pop("no_ssh", False),
+    }
+    o["leave_db_running?"] = o.pop("leave_db_running", False)
+    o.pop("node", None)
+    o.pop("nodes_file", None)
+    return o
+
+
+def run_test_n_times(test_fn: Callable[[dict], dict],
+                     opts: dict) -> int:
+    """single-test-cmd's run loop (cli.clj:389-399): runs test-count
+    tests, returning the worst exit code."""
+    from . import core
+
+    worst = 0
+    for _ in range(opts.get("test_count", 1)):
+        test = core.run(test_fn(opts))
+        valid = (test.get("results") or {}).get("valid?")
+        if valid is False:
+            return 1
+        if valid == "unknown":
+            worst = max(worst, 2)
+    return worst
+
+
+def test_all_run_tests(tests) -> dict:
+    """Runs tests, grouping store paths by outcome
+    (cli.clj:443-461). Outcomes: True, False, 'unknown', 'crashed'."""
+    from . import core
+    from . import store as jstore
+
+    out: dict = {}
+    for t in tests:
+        t = core.prepare_test(t)
+        where = str(jstore.test_dir(t))
+        try:
+            t = core.run(t)
+            key = (t.get("results") or {}).get("valid?")
+        except Exception:  # noqa: BLE001
+            logger.exception("Test crashed")
+            key = "crashed"
+        out.setdefault(key, []).append(where)
+    return out
+
+
+def test_all_print_summary(results: dict) -> dict:
+    """Prints grouped outcomes (cli.clj:463-492)."""
+    sections = [(True, "Successful tests"),
+                ("unknown", "Indeterminate tests"),
+                ("crashed", "Crashed tests"),
+                (False, "Failed tests")]
+    for key, title in sections:
+        if results.get(key):
+            print(f"\n# {title}\n")
+            for p in results[key]:
+                print(p)
+    print()
+    print(len(results.get(True, [])), "successes")
+    print(len(results.get("unknown", [])), "unknown")
+    print(len(results.get("crashed", [])), "crashed")
+    print(len(results.get(False, [])), "failures")
+    return results
+
+
+def test_all_exit_code(results: dict) -> int:
+    """255 if crashed, 2 if unknown, 1 if invalid, 0 otherwise
+    (cli.clj:494-502)."""
+    if results.get("crashed"):
+        return 255
+    if results.get("unknown"):
+        return 2
+    if results.get(False):
+        return 1
+    return 0
+
+
+def serve(host: str = "0.0.0.0", port: int = 8080, block: bool = True):
+    """Runs the store web UI (cli.clj serve-cmd, web.clj)."""
+    from . import web
+
+    server = web.serve(host, port)
+    logger.info("Listening on http://%s:%s/", host, port)
+    if block:
+        try:
+            while True:
+                time.sleep(1)
+        except KeyboardInterrupt:
+            server.shutdown()
+    return server
+
+
+class CliError(SystemExit):
+    pass
+
+
+def run_cli(subcommands: dict, argv=None) -> None:
+    """Dispatches `argv` to {name: {parser_fn?, run}} subcommands
+    (cli.clj run!, 258-335). run receives the parsed Namespace."""
+    argv = list(sys.argv[1:] if argv is None else argv)
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(levelname)s [%(name)s] %(message)s")
+    command = argv[0] if argv else None
+    if command not in subcommands:
+        print("Usage: python -m jepsen_tpu COMMAND [OPTIONS ...]")
+        print("Commands:", ", ".join(sorted(subcommands)))
+        raise SystemExit(254)
+    spec = subcommands[command]
+    parser = argparse.ArgumentParser(prog=command)
+    if spec.get("parser_fn"):
+        spec["parser_fn"](parser)
+    try:
+        options = parser.parse_args(argv[1:])
+    except SystemExit as e:
+        raise SystemExit(254 if e.code not in (0, None) else 0)
+    try:
+        code = spec["run"](options)
+    except SystemExit:
+        raise
+    except Exception:  # noqa: BLE001
+        logger.exception("Oh jeez, I'm sorry, Jepsen broke. Here's why:")
+        raise SystemExit(255)
+    raise SystemExit(code or 0)
+
+
+def single_test_cmd(test_fn, parser_fn=None, opt_fn=None) -> dict:
+    """A 'test' subcommand for a suite (cli.clj:355-442). test_fn:
+    options-dict -> test map."""
+    def run(options):
+        opts = test_opt_fn(options)
+        if opt_fn:
+            opts = opt_fn(opts)
+        return run_test_n_times(test_fn, opts)
+
+    def build(p):
+        add_test_opts(p)
+        if parser_fn:
+            parser_fn(p)
+        return p
+
+    return {"test": {"parser_fn": build, "run": run}}
+
+
+def test_all_cmd(tests_fn, parser_fn=None, opt_fn=None) -> dict:
+    """A 'test-all' subcommand sweeping a test matrix
+    (cli.clj:504-530). tests_fn: options-dict -> iterable of tests."""
+    def run(options):
+        opts = test_opt_fn(options)
+        if opt_fn:
+            opts = opt_fn(opts)
+        results = test_all_run_tests(tests_fn(opts))
+        test_all_print_summary(results)
+        return test_all_exit_code(results)
+
+    def build(p):
+        add_test_opts(p)
+        if parser_fn:
+            parser_fn(p)
+        return p
+
+    return {"test-all": {"parser_fn": build, "run": run}}
+
+
+def serve_cmd() -> dict:
+    """A 'serve' subcommand for the web UI (cli.clj:336-354)."""
+    def build(p):
+        p.add_argument("-b", "--host", default="0.0.0.0",
+                       help="Hostname to bind to")
+        p.add_argument("-p", "--port", type=int, default=8080,
+                       help="Port number to bind to")
+        return p
+
+    def run(options):
+        serve(options.host, options.port)
+        return 0
+
+    return {"serve": {"parser_fn": build, "run": run}}

@@ -75,7 +75,9 @@ class DbNemesis(n.Nemesis):
     def invoke(self, test, op):
         f = {"start": self.db.start, "kill": self.db.kill,
              "pause": self.db.pause, "resume": self.db.resume}[op.f]
-        nodes = db_nodes(test, self.db, op.value)
+        # None (e.g. 'primaries' with no known primaries) must no-op,
+        # not fall through to on_nodes' all-nodes default
+        nodes = db_nodes(test, self.db, op.value) or []
         res = control.on_nodes(test, lambda t, node: f(t, node), nodes)
         return op.copy(value=res)
 
@@ -252,7 +254,10 @@ class PacketNemesis(n.Nemesis):
 
 def packet_package(opts: dict) -> dict:
     """Packet-behavior package (combined.clj:289-328). opts['packet']:
-    {'targets': [spec...], 'behaviors': [{'delay': {}}, ...]}."""
+    {'targets': [spec...], 'behaviors': [{'delay': {}}, ...]}.
+    The default behaviors list is [{}] — a no-disruption behavior —
+    matching the reference; configure 'behaviors' to actually disturb
+    packets."""
     needed = "packet" in opts["faults"]
     db = opts["db"]
     popts = opts.get("packet") or {}

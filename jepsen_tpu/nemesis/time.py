@@ -126,11 +126,13 @@ class ClockNemesis(Nemesis):
     def setup(self, test):
         def body(t, n):
             install()
-            try:
-                with control.su():
-                    control.exec_("service", "ntpd", "stop")
-            except RemoteError:
-                pass
+            # the daemon is 'ntpd' on RHEL-likes, 'ntp' on Debian
+            for svc in ("ntpd", "ntp"):
+                try:
+                    with control.su():
+                        control.exec_("service", svc, "stop")
+                except RemoteError:
+                    pass
             _meh_reset()
         control.on_nodes(test, body)
         return self

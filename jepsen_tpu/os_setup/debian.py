@@ -59,15 +59,17 @@ def maybe_update() -> None:
 
 
 def installed(pkgs) -> set:
-    """The subset of pkgs currently installed (debian.clj:50-62)."""
+    """The subset of pkgs currently installed (debian.clj:50-62).
+    Lists all selections and filters host-side: dpkg exits 1 when a
+    named pattern matches nothing (i.e. on any fresh node)."""
     pkgs = {str(p) for p in pkgs}
-    out = control.exec_("dpkg", "--get-selections", *sorted(pkgs))
+    out = control.exec_("dpkg", "--get-selections")
     got = set()
     for line in out.split("\n"):
         parts = line.split()
         if len(parts) >= 2 and parts[1] == "install":
             got.add(re.sub(r":amd64|:i386", "", parts[0]))
-    return got
+    return got & pkgs
 
 
 def installed_p(pkg_or_pkgs) -> bool:

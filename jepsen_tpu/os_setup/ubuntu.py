@@ -1,4 +1,5 @@
-"""Ubuntu node preparation: Debian flows minus a few packages.
+"""Ubuntu node preparation: the Debian flows with a different package
+set.
 
 Capability reference: jepsen/src/jepsen/os/ubuntu.clj (whole file; it
 delegates hostfile/update/install to os/debian.clj).
@@ -6,13 +7,7 @@ delegates hostfile/update/install to os/debian.clj).
 
 from __future__ import annotations
 
-import logging
-
-from .. import util
-from . import OS
 from . import debian
-
-logger = logging.getLogger(__name__)
 
 PACKAGES = [
     "apt-transport-https", "wget", "curl", "vim", "man-db", "faketime",
@@ -21,20 +16,8 @@ PACKAGES = [
 ]
 
 
-class Ubuntu(OS):
+class Ubuntu(debian.Debian):
     packages = PACKAGES
-
-    def setup(self, test, node) -> None:
-        logger.info("%s setting up ubuntu", node)
-        debian.setup_hostfile()
-        debian.maybe_update()
-        debian.install(self.packages)
-        net = test.get("net")
-        if net is not None:
-            util.meh(lambda: net.heal(test))
-
-    def teardown(self, test, node) -> None:
-        pass
 
 
 os = Ubuntu()

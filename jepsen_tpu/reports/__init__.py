@@ -1,0 +1,8 @@
+"""Report rendering: latency/rate graphs, HTML timeline, clock plots.
+
+The reference keeps these under jepsen.checker.* (checker/perf.clj,
+checker/timeline.clj, checker/clock.clj); they live in their own
+package here because Python can't have both checker.perf() (the
+checker constructor, checker.clj latency-graph/rate-graph) and a
+checker.perf submodule.
+"""

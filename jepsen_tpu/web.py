@@ -1,0 +1,162 @@
+"""Web UI over the store: browse tests, results, and artifacts.
+
+Capability reference: jepsen/src/jepsen/web.clj — home page scanning
+the store with cheap header reads (51-112), per-test file browser with
+a path-traversal guard (288-388), zip download of a test directory
+(340-381), app routes '/' and '/files/' (431-446).
+"""
+
+from __future__ import annotations
+
+import html as _html
+import io
+import json
+import logging
+import threading
+import zipfile
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from pathlib import Path
+from urllib.parse import unquote
+
+from . import store as jstore
+
+logger = logging.getLogger(__name__)
+
+
+def fast_tests(base: Path | None = None) -> list:
+    """Cheap per-test summaries for the home page (web.clj:51-112):
+    reads only results.json, never the history."""
+    out = []
+    for td in jstore.tests(base=base):
+        res = None
+        try:
+            res = jstore.load_results(td)
+        except (OSError, json.JSONDecodeError):
+            pass
+        out.append({"name": td.parent.name, "time": td.name,
+                    "dir": td,
+                    "valid": (res or {}).get("valid?", "incomplete")})
+    return out
+
+
+def _valid_color(valid) -> str:
+    return {True: "#6DB6FE", False: "#FEB5DA",
+            "unknown": "#FFAA26"}.get(valid, "#eeeeee")
+
+
+def home_html(base: Path | None = None) -> str:
+    rows = []
+    for t in fast_tests(base):
+        rel = f"{t['name']}/{t['time']}"
+        rows.append(
+            f"<tr style='background:{_valid_color(t['valid'])}'>"
+            f"<td>{_html.escape(t['name'])}</td>"
+            f"<td><a href='/files/{_html.escape(rel)}/'>"
+            f"{_html.escape(t['time'])}</a></td>"
+            f"<td>{_html.escape(str(t['valid']))}</td>"
+            f"<td><a href='/files/{_html.escape(rel)}/results.json'>"
+            f"results</a></td>"
+            f"<td><a href='/files/{_html.escape(rel)}/jepsen.log'>log"
+            f"</a></td>"
+            f"<td><a href='/zip/{_html.escape(rel)}'>zip</a></td>"
+            f"</tr>")
+    return ("<!DOCTYPE html><html><head><meta charset='utf-8'>"
+            "<title>Jepsen</title><style>"
+            "body { font-family: sans-serif } "
+            "table { border-collapse: collapse } "
+            "td, th { padding: 4px 10px; text-align: left }"
+            "</style></head><body><h1>Jepsen</h1><table>"
+            "<tr><th>Test</th><th>Time</th><th>Valid?</th>"
+            "<th colspan=3>Artifacts</th></tr>"
+            + "".join(rows) + "</table></body></html>")
+
+
+def dir_html(rel: str, d: Path) -> str:
+    entries = sorted(d.iterdir(),
+                     key=lambda p: (not p.is_dir(), p.name))
+    items = "".join(
+        f"<li><a href='/files/{_html.escape(rel)}{_html.escape(e.name)}"
+        f"{'/' if e.is_dir() else ''}'>{_html.escape(e.name)}"
+        f"{'/' if e.is_dir() else ''}</a></li>" for e in entries)
+    return (f"<!DOCTYPE html><html><body><h1>{_html.escape(rel)}</h1>"
+            f"<ul>{items}</ul></body></html>")
+
+
+CONTENT_TYPES = {".html": "text/html", ".json": "application/json",
+                 ".log": "text/plain", ".txt": "text/plain",
+                 ".png": "image/png", ".svg": "image/svg+xml",
+                 ".jlog": "application/octet-stream"}
+
+
+class StoreHandler(BaseHTTPRequestHandler):
+    base: Path = Path("store")
+
+    def log_message(self, fmt, *args):  # quiet
+        logger.debug("web: " + fmt, *args)
+
+    def _send(self, code: int, body: bytes,
+              ctype: str = "text/html") -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _resolve(self, rel: str) -> Path | None:
+        """Path-traversal guard (web.clj:382-388): the resolved path
+        must stay under the store root."""
+        p = (self.base / rel).resolve()
+        root = self.base.resolve()
+        if p == root or root in p.parents:
+            return p
+        return None
+
+    def do_GET(self):  # noqa: N802
+        path = unquote(self.path.split("?", 1)[0])
+        try:
+            if path == "/":
+                self._send(200, home_html(self.base).encode())
+            elif path.startswith("/files/"):
+                rel = path[len("/files/"):]
+                p = self._resolve(rel)
+                if p is None or not p.exists():
+                    self._send(404, b"not found", "text/plain")
+                elif p.is_dir():
+                    if not path.endswith("/"):
+                        rel += "/"
+                    self._send(200, dir_html(rel, p).encode())
+                else:
+                    ctype = CONTENT_TYPES.get(p.suffix, "text/plain")
+                    self._send(200, p.read_bytes(), ctype)
+            elif path.startswith("/zip/"):
+                rel = path[len("/zip/"):].rstrip("/")
+                p = self._resolve(rel)
+                if p is None or not p.is_dir():
+                    self._send(404, b"not found", "text/plain")
+                else:
+                    buf = io.BytesIO()
+                    with zipfile.ZipFile(buf, "w",
+                                         zipfile.ZIP_DEFLATED) as z:
+                        for f in sorted(p.rglob("*")):
+                            if f.is_file():
+                                z.write(f, f.relative_to(p.parent))
+                    self._send(200, buf.getvalue(), "application/zip")
+            else:
+                self._send(404, b"not found", "text/plain")
+        except BrokenPipeError:
+            pass
+        except Exception:  # noqa: BLE001
+            logger.exception("web error")
+            self._send(500, b"internal error", "text/plain")
+
+
+def serve(host: str = "0.0.0.0", port: int = 8080,
+          base: Path | None = None) -> ThreadingHTTPServer:
+    """Starts the store browser on a daemon thread; returns the server
+    (web.clj:431-446)."""
+    handler = type("Handler", (StoreHandler,),
+                   {"base": Path(base) if base else jstore.BASE})
+    server = ThreadingHTTPServer((host, port), handler)
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    return server

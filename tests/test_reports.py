@@ -1,0 +1,161 @@
+"""Report checkers: perf graphs, timeline HTML, clock plot — artifacts
+written into the store dir, plus the pure math helpers."""
+
+import pytest
+
+from jepsen_tpu import checker, util
+from jepsen_tpu.reports import clock as clock_mod
+from jepsen_tpu.reports import perf as perf_mod
+from jepsen_tpu.reports import timeline as timeline_mod
+from jepsen_tpu.history import History, op
+
+
+def sec(s):
+    return util.secs_to_nanos(s)
+
+
+def register_history():
+    events = []
+    i = 0
+    for t in range(20):
+        events.append(op(index=i, time=sec(t) + 1, type="invoke",
+                         process=t % 3, f="read", value=None))
+        typ = ["ok", "ok", "ok", "fail", "info"][t % 5]
+        events.append(op(index=i + 1, time=sec(t) + int(2e8), type=typ,
+                         process=t % 3, f="read", value=1))
+        i += 2
+    # a nemesis interval for shading
+    events.append(op(index=i, time=sec(5), type="info",
+                     process="nemesis", f="start", value=None))
+    events.append(op(index=i + 1, time=sec(12), type="info",
+                     process="nemesis", f="stop", value=None))
+    events.sort(key=lambda o: o.time)
+    return History(events, assign_indices=False)
+
+
+def test_bucketing():
+    assert perf_mod.bucket_time(10, 3) == 5.0
+    assert perf_mod.bucket_time(10, 17) == 15.0
+    got = perf_mod.bucket_points(10, [[1, "a"], [2, "b"], [11, "c"]])
+    assert got == {5.0: [[1, "a"], [2, "b"]], 15.0: [[11, "c"]]}
+
+
+def test_quantiles():
+    q = perf_mod.quantiles([0.5, 1.0], [5, 1, 3, 2, 4])
+    assert q == {0.5: 3, 1.0: 5}
+    assert perf_mod.quantiles([0.5], []) == {}
+
+
+def test_latencies_to_quantiles():
+    pts = [[t, float(t)] for t in range(20)]
+    q = perf_mod.latencies_to_quantiles(10, [1.0], pts)
+    assert q[1.0] == [[5.0, 9.0], [15.0, 19.0]]
+
+
+def test_invokes_by_f_type():
+    h = register_history()
+    by = perf_mod.invokes_by_f_type(h)
+    assert set(by) == {"read"}
+    assert sum(len(v) for v in by["read"].values()) == 20
+    assert len(by["read"]["fail"]) == 4
+    assert len(by["read"]["info"]) == 4
+
+
+def test_perf_checker_writes_artifacts(tmp_path):
+    test = {"name": "perf-test", "store_dir": str(tmp_path),
+            "nodes": ["n1"],
+            "plot": {"nemeses": [{"name": "nemesis",
+                                  "start": {"start"}, "stop": {"stop"},
+                                  "color": "#E9DCA0"}]}}
+    res = checker.check_safe(checker.perf(), test, register_history())
+    assert res["valid?"] is True
+    files = (res["latency-graph"]["files"]
+             + res["rate-graph"]["files"])
+    names = {f.split("/")[-1] for f in files}
+    assert names == {"latency-raw.png", "latency-quantiles.png",
+                     "rate.png"}
+    for f in files:
+        import os
+        assert os.path.getsize(f) > 1000
+
+
+def test_perf_checker_skips_without_store():
+    res = checker.check_safe(checker.perf(), {"nodes": []},
+                             register_history())
+    assert res["valid?"] is True
+    assert res["latency-graph"]["skipped"]
+
+
+def test_timeline_pairs():
+    h = History([
+        op(type="invoke", process=0, f="w", value=1),
+        op(type="invoke", process=1, f="r", value=None),
+        op(type="ok", process=0, f="w", value=1),
+        op(type="info", process=1, f="r", value=None),
+        op(type="info", process="nemesis", f="start", value=None),
+    ])
+    prs = timeline_mod.pairs(h)
+    shapes = {(str(p[0].process), len(p)) for p in prs}
+    assert ("0", 2) in shapes and ("1", 2) in shapes
+    assert ("nemesis", 1) in shapes
+
+
+def test_timeline_html(tmp_path):
+    test = {"name": "tl", "store_dir": str(tmp_path)}
+    res = checker.check_safe(timeline_mod.html(), test,
+                             register_history())
+    assert res["valid?"] is True
+    text = (tmp_path / "timeline.html").read_text()
+    assert "op ok" in text and "op fail" in text and "op info" in text
+    assert text.count("class=\"op ") == 22  # 20 client pairs + 2 nemesis
+    assert "Truncated" not in text
+
+
+def test_timeline_truncates(tmp_path, monkeypatch):
+    monkeypatch.setattr(timeline_mod, "OP_LIMIT", 5)
+    test = {"name": "tl", "store_dir": str(tmp_path)}
+    checker.check_safe(timeline_mod.html(), test, register_history())
+    text = (tmp_path / "timeline.html").read_text()
+    assert "Truncated to 5 operations" in text
+
+
+def test_clock_datasets():
+    h = History([
+        op(index=0, time=sec(1), type="info", process="nemesis",
+           f="check-offsets", value=None,
+           **{"clock-offsets": {"n1": 0.5, "n2": -0.25}}),
+        op(index=1, time=sec(3), type="info", process="nemesis",
+           f="bump", value=None, **{"clock-offsets": {"n1": 2.0}}),
+        op(index=2, time=sec(4), type="ok", process=0, f="read",
+           value=1),
+    ], assign_indices=False)
+    ds = clock_mod.history_to_datasets(h)
+    assert ds["n1"] == [[1.0, 0.5], [3.0, 2.0], [4.0, 2.0]]
+    assert ds["n2"] == [[1.0, -0.25], [4.0, -0.25]]
+
+
+def test_short_node_names():
+    got = clock_mod.short_node_names(
+        ["n1.cluster.local", "n2.cluster.local"])
+    assert got == ["n1", "n2"]
+    assert clock_mod.short_node_names(["a", "b"]) == ["a", "b"]
+
+
+def test_clock_plot_writes(tmp_path):
+    test = {"name": "clock", "store_dir": str(tmp_path)}
+    h = History([
+        op(index=0, time=sec(1), type="info", process="nemesis",
+           f="check-offsets", value=None,
+           **{"clock-offsets": {"n1": 0.0, "n2": 0.1}}),
+        op(index=1, time=sec(5), type="info", process="nemesis",
+           f="bump", value=None, **{"clock-offsets": {"n1": 8.0}}),
+    ], assign_indices=False)
+    res = checker.check_safe(checker.clock_plot(), test, h)
+    assert res["valid?"] is True
+    assert (tmp_path / "clock-skew.png").stat().st_size > 1000
+
+
+def test_clock_plot_empty_history_ok(tmp_path):
+    test = {"name": "clock", "store_dir": str(tmp_path)}
+    res = checker.check_safe(checker.clock_plot(), test, History([]))
+    assert res["valid?"] is True
