@@ -71,28 +71,30 @@ def ls_full(directory: str) -> list:
 
 def tmp_file() -> str:
     """Creates a random temp file under TMP_DIR_BASE, returning its path
-    (control/util.clj tmp-file!)."""
+    (control/util.clj tmp-file!). Atomic: noclobber create instead of a
+    probe-then-touch race (which also loops forever against remotes
+    whose stat always succeeds, like the dummy)."""
+    exec_("mkdir", "-p", TMP_DIR_BASE)
     while True:
         path = f"{TMP_DIR_BASE}/{random.randrange(2 ** 31)}"
-        if exists_p(path):
-            continue
         try:
-            exec_("touch", path)
+            exec_("bash", "-c", f"set -C; : > {path}")
+            return path
         except RemoteError:
-            exec_("mkdir", "-p", TMP_DIR_BASE)
-            exec_("touch", path)
-        return path
+            continue
 
 
 def tmp_dir() -> str:
     """Creates a random temp dir under TMP_DIR_BASE
-    (control/util.clj tmp-dir!)."""
+    (control/util.clj tmp-dir!). Atomic: bare mkdir fails if present."""
+    exec_("mkdir", "-p", TMP_DIR_BASE)
     while True:
         path = f"{TMP_DIR_BASE}/{random.randrange(2 ** 31)}"
-        if exists_p(path):
+        try:
+            exec_("mkdir", path)
+            return path
+        except RemoteError:
             continue
-        exec_("mkdir", "-p", path)
-        return path
 
 
 def write_file(string: str, filename) -> str:

@@ -1,0 +1,2 @@
+"""DB test suites: consumers of the framework that install and drive
+real databases (the reference ships ~26 of these; see SURVEY.md 2.6)."""

@@ -1,0 +1,372 @@
+"""etcd test suite: install/start/stop etcd, drive it over its HTTP v3
+gateway, check registers (linearizable) and list-append (elle).
+
+Capability reference: the reference's canonical tutorial suite
+(doc/tutorial/index.md:13-20; DB install/daemon flow in
+doc/tutorial/02-db.md: /opt/etcd install-archive + start-stop-daemon
+with --initial-cluster flags; client and checker shape in 03-client.md,
+04-checker.md; zookeeper/src/jepsen/zookeeper.clj is the size model).
+
+Run clusterless against the dummy remote in CI (command emission is
+tested), or for real: python -m jepsen_tpu.suites.etcd test
+--nodes ... --username root.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import logging
+import random
+import urllib.error
+import urllib.request
+
+from .. import checker as chk
+from .. import cli, client as jclient, control, db as jdb, independent
+from .. import generator as gen
+from .. import nemesis as jnemesis
+from .. import testing, workloads
+from ..checker import models
+from ..control import util as cu
+from ..os_setup import debian
+
+logger = logging.getLogger(__name__)
+
+VERSION = "v3.5.15"
+DIR = "/opt/etcd"
+BINARY = f"{DIR}/etcd"
+LOGFILE = f"{DIR}/etcd.log"
+PIDFILE = f"{DIR}/etcd.pid"
+
+CLIENT_PORT = 2379
+PEER_PORT = 2380
+
+
+def node_url(node, port) -> str:
+    return f"http://{node}:{port}"
+
+
+def peer_url(node) -> str:
+    return node_url(node, PEER_PORT)
+
+
+def client_url(node) -> str:
+    return node_url(node, CLIENT_PORT)
+
+
+def initial_cluster(test) -> str:
+    """node1=http://node1:2380,... (tutorial 02-db.md
+    initial-cluster)."""
+    return ",".join(f"{n}={peer_url(n)}" for n in test["nodes"])
+
+
+class EtcdDB(jdb.DB):
+    """Installs and runs an etcd node (tutorial 02-db.md)."""
+
+    supports_kill = True
+    supports_pause = True
+
+    def __init__(self, version: str = VERSION):
+        self.version = version
+
+    def setup(self, test, node):
+        logger.info("%s installing etcd %s", node, self.version)
+        with control.su():
+            url = (f"https://storage.googleapis.com/etcd/{self.version}"
+                   f"/etcd-{self.version}-linux-amd64.tar.gz")
+            cu.install_archive(url, DIR)
+            cu.start_daemon(
+                {"logfile": LOGFILE, "pidfile": PIDFILE, "chdir": DIR},
+                BINARY,
+                "--log-outputs", "stderr",
+                "--name", str(node),
+                "--listen-peer-urls", peer_url(node),
+                "--listen-client-urls", f"http://0.0.0.0:{CLIENT_PORT}",
+                "--advertise-client-urls", client_url(node),
+                "--initial-cluster-state", "new",
+                "--initial-advertise-peer-urls", peer_url(node),
+                "--initial-cluster", initial_cluster(test))
+        cu.await_tcp_port(CLIENT_PORT, timeout_secs=60)
+
+    def teardown(self, test, node):
+        logger.info("%s tearing down etcd", node)
+        with control.su():
+            cu.stop_daemon(BINARY, PIDFILE)
+            control.exec_("rm", "-rf", DIR)
+
+    def kill(self, test, node):
+        with control.su():
+            cu.grepkill("etcd")
+        return "killed"
+
+    def start(self, test, node):
+        self.setup_daemon_only(test, node)
+        return "started"
+
+    def setup_daemon_only(self, test, node):
+        with control.su():
+            cu.start_daemon(
+                {"logfile": LOGFILE, "pidfile": PIDFILE, "chdir": DIR},
+                BINARY,
+                "--log-outputs", "stderr",
+                "--name", str(node),
+                "--listen-peer-urls", peer_url(node),
+                "--listen-client-urls", f"http://0.0.0.0:{CLIENT_PORT}",
+                "--advertise-client-urls", client_url(node),
+                "--initial-cluster-state", "new",
+                "--initial-advertise-peer-urls", peer_url(node),
+                "--initial-cluster", initial_cluster(test))
+
+    def pause(self, test, node):
+        with control.su():
+            cu.grepkill("etcd", "stop")
+        return "paused"
+
+    def resume(self, test, node):
+        with control.su():
+            cu.grepkill("etcd", "cont")
+        return "resumed"
+
+    def log_files(self, test, node):
+        return [LOGFILE]
+
+
+# ---------------------------------------------------------------------------
+# Client over the v3 HTTP/JSON gateway
+# ---------------------------------------------------------------------------
+
+def _b64(s: str) -> str:
+    return base64.b64encode(s.encode()).decode()
+
+
+def _unb64(s: str) -> str:
+    return base64.b64decode(s).decode()
+
+
+class EtcdHttp:
+    """Minimal etcd v3 JSON-gateway driver (kv/range, kv/put, kv/txn).
+    Split out so tests can stub `post`."""
+
+    def __init__(self, node, timeout: float = 5.0):
+        self.base = client_url(node)
+        self.timeout = timeout
+
+    def post(self, path: str, body: dict) -> dict:
+        req = urllib.request.Request(
+            self.base + path, data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=self.timeout) as r:
+            return json.loads(r.read().decode())
+
+    def get(self, key: str):
+        """(value, mod_revision) or (None, None)."""
+        out = self.post("/v3/kv/range", {"key": _b64(key)})
+        kvs = out.get("kvs") or []
+        if not kvs:
+            return None, None
+        return (_unb64(kvs[0].get("value", "")),
+                int(kvs[0].get("mod_revision", 0)))
+
+    def put(self, key: str, value: str) -> None:
+        self.post("/v3/kv/put", {"key": _b64(key), "value": _b64(value)})
+
+    def cas(self, key: str, old: str, new: str) -> bool:
+        """Atomic value-equality compare-and-set via kv/txn."""
+        out = self.post("/v3/kv/txn", {
+            "compare": [{"key": _b64(key), "target": "VALUE",
+                         "value": _b64(old), "result": "EQUAL"}],
+            "success": [{"requestPut": {"key": _b64(key),
+                                        "value": _b64(new)}}]})
+        return bool(out.get("succeeded"))
+
+    def cas_create(self, key: str, new: str) -> bool:
+        """Create iff absent (create_revision == 0)."""
+        out = self.post("/v3/kv/txn", {
+            "compare": [{"key": _b64(key), "target": "CREATE",
+                         "create_revision": "0"}],
+            "success": [{"requestPut": {"key": _b64(key),
+                                        "value": _b64(new)}}]})
+        return bool(out.get("succeeded"))
+
+
+def _definite(e: Exception) -> bool:
+    """True when the request certainly never executed (safe to :fail);
+    timeouts and other errors are indeterminate (:info)."""
+    if isinstance(e, urllib.error.URLError):
+        reason = getattr(e, "reason", None)
+        return isinstance(reason, ConnectionRefusedError)
+    return isinstance(e, ConnectionRefusedError)
+
+
+class EtcdRegisterClient(jclient.Client):
+    """Per-key register ops (read/write/cas) over independent-key
+    tuples (tutorial 03-client.md)."""
+
+    def __init__(self, http_factory=EtcdHttp):
+        self.http_factory = http_factory
+        self.http = None
+
+    def open(self, test, node):
+        c = EtcdRegisterClient(self.http_factory)
+        c.http = self.http_factory(node)
+        return c
+
+    def invoke(self, test, op):
+        k, v = independent.key_(op.value), independent.value_(op.value)
+        key = f"/register/{k}"
+        try:
+            if op.f == "read":
+                val, _ = self.http.get(key)
+                val = None if val is None else int(val)
+                return op.copy(type="ok",
+                               value=independent.ktuple(k, val))
+            if op.f == "write":
+                self.http.put(key, str(v))
+                return op.copy(type="ok")
+            if op.f == "cas":
+                old, new = v
+                ok = self.http.cas(key, str(old), str(new))
+                return op.copy(type="ok" if ok else "fail")
+            raise ValueError(f"unknown f {op.f!r}")
+        except Exception as e:  # noqa: BLE001
+            if _definite(e):
+                return op.copy(type="fail", error=repr(e))
+            return op.copy(type="info", error=repr(e))
+
+
+class EtcdAppendClient(jclient.Client):
+    """Elle list-append transactions: each [f k v] micro-op reads or
+    appends to a JSON list under /append/<k>, appends via
+    mod-revision-guarded txns retried a few times."""
+
+    def __init__(self, http_factory=EtcdHttp, retries: int = 8):
+        self.http_factory = http_factory
+        self.retries = retries
+        self.http = None
+
+    def open(self, test, node):
+        c = EtcdAppendClient(self.http_factory, self.retries)
+        c.http = self.http_factory(node)
+        return c
+
+    def _append(self, key: str, v) -> None:
+        for _ in range(self.retries):
+            cur, _rev = self.http.get(key)
+            if cur is None:
+                if self.http.cas_create(key, json.dumps([v])):
+                    return
+                continue
+            lst = json.loads(cur)
+            if self.http.cas(key, cur, json.dumps(lst + [v])):
+                return
+        raise RuntimeError(f"append contention on {key}")
+
+    def invoke(self, test, op):
+        try:
+            out = []
+            for f, k, v in op.value:
+                key = f"/append/{k}"
+                if f == "r":
+                    cur, _ = self.http.get(key)
+                    out.append(
+                        ["r", k, json.loads(cur) if cur else None])
+                else:
+                    self._append(key, v)
+                    out.append(["append", k, v])
+            return op.copy(type="ok", value=out)
+        except Exception as e:  # noqa: BLE001
+            if _definite(e):
+                return op.copy(type="fail", error=repr(e))
+            return op.copy(type="info", error=repr(e))
+
+
+# ---------------------------------------------------------------------------
+# Tests
+# ---------------------------------------------------------------------------
+
+def r(rng):
+    return {"f": "read", "value": None}
+
+
+def w(rng):
+    return {"f": "write", "value": rng.randrange(5)}
+
+
+def cas(rng):
+    return {"f": "cas", "value": [rng.randrange(5), rng.randrange(5)]}
+
+
+def register_workload(opts: dict) -> dict:
+    rng = random.Random(opts.get("seed"))
+    keys = list(range(opts.get("keys", 4)))
+    return {
+        "client": EtcdRegisterClient(),
+        "generator": independent.concurrent_generator(
+            opts["concurrency"], keys,
+            lambda k: gen.limit(opts.get("ops_per_key", 200),
+                                lambda: rng.choice([r, w, cas])(rng))),
+        "checker": independent.checker(chk.linearizable(
+            {"model": models.cas_register()})),
+    }
+
+
+def append_workload(opts: dict) -> dict:
+    w = workloads.txn_append.workload(
+        {"ops": opts.get("ops", 1000), "seed": opts.get("seed")})
+    w["client"] = EtcdAppendClient()
+    return w
+
+
+WORKLOADS = {"register": register_workload, "append": append_workload}
+
+
+def etcd_test(opts: dict) -> dict:
+    """Constructs an etcd test map from CLI options (the tutorial's
+    etcd-test / zookeeper.clj zk-test shape)."""
+    name = opts.get("workload", "register")
+    w = WORKLOADS[name](opts)
+    test = testing.noop_test()
+    test.update(
+        name=f"etcd-{name}",
+        os=debian.os,
+        db=EtcdDB(opts.get("version", VERSION)),
+        ssh=opts["ssh"],
+        nodes=opts["nodes"],
+        concurrency=opts["concurrency"],
+        client=w["client"],
+        nemesis=jnemesis.partition_random_halves(),
+        checker=chk.compose({"workload": w["checker"],
+                             "stats": chk.stats(),
+                             "perf": chk.perf(),
+                             "timeline": chk.timeline()}),
+        generator=gen.clients(
+            gen.time_limit(
+                opts.get("time_limit", 30),
+                gen.stagger(1.0 / opts.get("rate", 50),
+                            w["generator"])),
+            gen.cycle(gen.phases(gen.sleep(5),
+                                 {"type": "info", "f": "start"},
+                                 gen.sleep(5),
+                                 {"type": "info", "f": "stop"}))))
+    return test
+
+
+def _workload_opt(p):
+    p.add_argument("--workload", default="register",
+                   help="Workload. " + cli.one_of(WORKLOADS))
+    p.add_argument("--version", default=VERSION,
+                   help="etcd version tag to install.")
+    p.add_argument("--rate", type=float, default=50)
+    return p
+
+
+def main(argv=None) -> None:
+    commands = {}
+    commands.update(cli.single_test_cmd(etcd_test,
+                                        parser_fn=_workload_opt))
+    commands.update(cli.serve_cmd())
+    cli.run_cli(commands, argv)
+
+
+if __name__ == "__main__":
+    main()

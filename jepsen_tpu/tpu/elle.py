@@ -19,9 +19,9 @@ Pipeline here:
      the graph step the reference runs on the JVM), cycle witness
      extraction host-side, classified by edge composition.
 
-Realtime edges use the last-completion link plus per-process chains — a
-sound subset of the full interval order (may under-detect strict-only
-cycles, never false-positives); see check() docstring.
+Realtime edges implement the FULL interval order (A precedes B iff A
+completed before B invoked), reduced by a covering-frontier sweep to
+O(n * concurrency) edges; per-process chains carry session order.
 """
 
 from __future__ import annotations
@@ -213,8 +213,11 @@ class AppendAnalysis:
 
 
 def _order_edges(committed: list[Txn]) -> list[tuple[int, int, int]]:
-    """Process chains (total per process) + last-completion realtime
-    links — a sound subset of the full realtime interval order."""
+    """Process chains (session order per process) plus the FULL
+    realtime interval order, reduced: a time sweep keeps a covering
+    frontier of completed txns, so A reaches B by realtime edges iff
+    A completed before B invoked — exactly elle's realtime relation,
+    with O(n * concurrency) edges instead of O(n^2)."""
     edges = []
     by_proc: dict = defaultdict(list)
     for t in committed:
@@ -223,14 +226,25 @@ def _order_edges(committed: list[Txn]) -> list[tuple[int, int, int]]:
         ts.sort(key=lambda t: t.invoke_pos)
         for a, b in zip(ts, ts[1:]):
             edges.append((a.i, b.i, PROC))
-    by_complete = sorted(committed, key=lambda t: t.complete_pos)
-    cs = np.array([t.complete_pos for t in by_complete])
+    # Sweep events in history order. On a completion, drop frontier
+    # members the completing txn already covers (their completion
+    # precedes its invocation, so an edge to it was emitted at its
+    # invoke); on an invocation, link every frontier member in.
+    events = []
     for t in committed:
-        j = np.searchsorted(cs, t.invoke_pos) - 1
-        if j >= 0:
-            prev = by_complete[j]
-            if prev.i != t.i:
-                edges.append((prev.i, t.i, RT))
+        events.append((t.invoke_pos, t))
+        events.append((t.complete_pos, t))
+    events.sort(key=lambda e: e[0])
+    frontier: list[Txn] = []
+    for pos, t in events:
+        if pos == t.invoke_pos:
+            for a in frontier:
+                if a.i != t.i:
+                    edges.append((a.i, t.i, RT))
+        else:
+            frontier[:] = [y for y in frontier
+                           if y.complete_pos >= t.invoke_pos]
+            frontier.append(t)
     return edges
 
 
@@ -322,6 +336,7 @@ def cycle_anomalies(n: int, edges, txns) -> dict[str, list]:
         [e for e in edges if e[2] == WW],
         [e for e in edges if e[2] in (WW, WR)],
         [e for e in edges if e[2] in (WW, WR, RW)],
+        [e for e in edges if e[2] in (WW, WR, RW, PROC)],
         list(edges),
     ]
     seen_sccs: set = set()
@@ -377,7 +392,9 @@ def check_rw_register(hist, opts: dict | None = None) -> dict:
     txns = collect(hist)
     anomalies: dict[str, list] = defaultdict(list)
     writer: dict = {}
+    intermediate: dict = {}  # (k, v) -> txn, for non-final writes
     for t in txns:
+        per_key_writes: dict = defaultdict(list)
         for mop in t.mops:
             f, k, v = mop[0], mop[1], mop[2]
             if f == "w":
@@ -389,6 +406,28 @@ def check_rw_register(hist, opts: dict | None = None) -> dict:
                         {"key": k, "value": v, "op": t.op})
                 if t.type != h.FAIL or prev is None:
                     writer[key] = t
+                per_key_writes[k].append(v)
+        if t.type != h.FAIL:
+            for k, vs in per_key_writes.items():
+                for v in vs[:-1]:
+                    intermediate[(k, _freeze(v))] = t
+
+    # internal consistency: each mop must agree with the txn's own
+    # prior reads/writes of that key (elle.rw-register internal)
+    for t in txns:
+        if t.type != h.OK:
+            continue
+        expected: dict = {}
+        for mop in t.mops:
+            f, k, v = mop[0], mop[1], mop[2]
+            if f == "w":
+                expected[k] = v
+            elif f == "r" and v is not None:
+                if k in expected and expected[k] != v:
+                    anomalies["internal"].append(
+                        {"key": k, "expected": expected[k],
+                         "read": v, "op": t.op})
+                expected[k] = v
 
     edges: list[tuple[int, int, int]] = []
     succ: dict = {}  # (k, v) -> next written value, when proven
@@ -409,6 +448,11 @@ def check_rw_register(hist, opts: dict | None = None) -> dict:
                             {"key": k, "value": v, "op": t.op,
                              "writer": w.op})
                     elif w.i != t.i:
+                        iw = intermediate.get((k, _freeze(v)))
+                        if iw is not None and iw.i != t.i:
+                            anomalies["G1b"].append(
+                                {"key": k, "value": v, "op": t.op,
+                                 "writer": iw.op})
                         edges.append((w.i, t.i, WR))
                 last_read[k] = v
             elif f == "w":

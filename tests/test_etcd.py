@@ -1,0 +1,240 @@
+"""etcd suite tests: DB command emission via the dummy remote, client
+semantics against an in-memory fake gateway, and clusterless
+end-to-end runs (correct + broken fakes)."""
+
+import json
+import threading
+import urllib.error
+
+import pytest
+
+from jepsen_tpu import checker as chk
+from jepsen_tpu import control, core, independent, testing
+from jepsen_tpu import generator as gen
+from jepsen_tpu.control.core import Action
+from jepsen_tpu.control.dummy import DummyRemote
+from jepsen_tpu.history import op
+from jepsen_tpu.suites import etcd
+
+
+def fresh_node_responder(node, action):
+    """stat fails: nothing is installed/cached on this 'node' yet."""
+    from jepsen_tpu.control.core import Result
+
+    if action.cmd.startswith("stat "):
+        return Result(exit=1, out="", err="no such file",
+                      cmd=action.cmd)
+    if action.cmd.startswith("dirname "):
+        return action.cmd.split()[-1].rsplit("/", 1)[0]
+    if action.cmd.startswith("ls -A"):
+        return "etcd-v3.5.15-linux-amd64"
+    return None
+
+
+@pytest.fixture()
+def test_map():
+    remote = DummyRemote(fresh_node_responder)
+    nodes = ["n1", "n2", "n3"]
+    t = {"nodes": nodes, "remote": remote, "ssh": {},
+         "sessions": {n: remote.connect({"host": n}) for n in nodes}}
+    return t
+
+
+def cmds(test, node):
+    return [a.cmd for a in test["sessions"][node].log
+            if isinstance(a, Action)]
+
+
+def test_initial_cluster(test_map):
+    assert etcd.initial_cluster(test_map) == (
+        "n1=http://n1:2380,n2=http://n2:2380,n3=http://n3:2380")
+
+
+def test_db_setup_commands(test_map):
+    db = etcd.EtcdDB("v3.5.15")
+    with control.with_session(test_map, "n1"):
+        db.setup(test_map, "n1")
+    got = cmds(test_map, "n1")
+    assert any(c.startswith("wget") and "etcd-v3.5.15-linux-amd64"
+               in c for c in got)
+    daemon = [c for c in got if c.startswith("start-stop-daemon")]
+    assert len(daemon) == 1
+    d = daemon[0]
+    assert "--startas /opt/etcd/etcd" in d
+    assert "--name n1" in d
+    assert "--listen-peer-urls http://n1:2380" in d
+    assert ("--initial-cluster "
+            "n1=http://n1:2380,n2=http://n2:2380,n3=http://n3:2380"
+            in d)
+    assert "nc -z localhost 2379" in got
+
+
+def test_db_teardown_kill_pause(test_map):
+    db = etcd.EtcdDB()
+    with control.with_session(test_map, "n2"):
+        db.teardown(test_map, "n2")
+        db.kill(test_map, "n2")
+        db.pause(test_map, "n2")
+        db.resume(test_map, "n2")
+    got = cmds(test_map, "n2")
+    assert "killall -9 -w /opt/etcd/etcd" in got
+    assert "rm -rf /opt/etcd" in got
+    assert any("pgrep -f --ignore-ancestors etcd" in c
+               and "kill -9" in c for c in got)
+    assert any("kill -STOP" in c for c in got)
+    assert any("kill -CONT" in c for c in got)
+
+
+# ---------------------------------------------------------------------------
+# Fake gateway
+# ---------------------------------------------------------------------------
+
+class FakeEtcd:
+    """Shared in-memory etcd v3 KV semantics (linearizable)."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.kv: dict = {}
+
+    def factory(self, node):
+        return FakeHttp(self)
+
+
+class FakeHttp:
+    def __init__(self, state: FakeEtcd):
+        self.state = state
+
+    def get(self, key):
+        with self.state.lock:
+            if key not in self.state.kv:
+                return None, None
+            return self.state.kv[key], 1
+
+    def put(self, key, value):
+        with self.state.lock:
+            self.state.kv[key] = value
+
+    def cas(self, key, old, new):
+        with self.state.lock:
+            if self.state.kv.get(key) == old:
+                self.state.kv[key] = new
+                return True
+            return False
+
+    def cas_create(self, key, new):
+        with self.state.lock:
+            if key not in self.state.kv:
+                self.state.kv[key] = new
+                return True
+            return False
+
+
+def test_register_client_ops():
+    state = FakeEtcd()
+    c = etcd.EtcdRegisterClient(state.factory).open({}, "n1")
+    t = independent.ktuple
+    done = c.invoke({}, op(type="invoke", f="read", value=t(1, None)))
+    assert done.type == "ok" and done.value == t(1, None)
+    done = c.invoke({}, op(type="invoke", f="write", value=t(1, 3)))
+    assert done.type == "ok"
+    done = c.invoke({}, op(type="invoke", f="read", value=t(1, None)))
+    assert done.value == t(1, 3)
+    done = c.invoke({}, op(type="invoke", f="cas", value=t(1, [3, 4])))
+    assert done.type == "ok"
+    done = c.invoke({}, op(type="invoke", f="cas", value=t(1, [9, 5])))
+    assert done.type == "fail"
+    done = c.invoke({}, op(type="invoke", f="read", value=t(1, None)))
+    assert done.value == t(1, 4)
+
+
+def test_append_client_txns():
+    state = FakeEtcd()
+    c = etcd.EtcdAppendClient(state.factory).open({}, "n1")
+    done = c.invoke({}, op(type="invoke", f="txn",
+                           value=[["append", "x", 1], ["r", "x", None]]))
+    assert done.type == "ok"
+    assert done.value == [["append", "x", 1], ["r", "x", [1]]]
+    c.invoke({}, op(type="invoke", f="txn",
+                    value=[["append", "x", 2]]))
+    done = c.invoke({}, op(type="invoke", f="txn",
+                           value=[["r", "x", None]]))
+    assert done.value == [["r", "x", [1, 2]]]
+
+
+def test_error_mapping():
+    class Boom:
+        def __init__(self, exc):
+            self.exc = exc
+
+        def get(self, key):
+            raise self.exc
+
+    refused = urllib.error.URLError(ConnectionRefusedError(111))
+    c = etcd.EtcdRegisterClient(lambda n: Boom(refused)).open({}, "n1")
+    done = c.invoke({}, op(type="invoke", f="read",
+                           value=independent.ktuple(1, None)))
+    assert done.type == "fail"  # definitely never executed
+
+    timed = urllib.error.URLError(TimeoutError())
+    c = etcd.EtcdRegisterClient(lambda n: Boom(timed)).open({}, "n1")
+    done = c.invoke({}, op(type="invoke", f="read",
+                           value=independent.ktuple(1, None)))
+    assert done.type == "info"  # indeterminate
+
+
+# ---------------------------------------------------------------------------
+# Clusterless end-to-end
+# ---------------------------------------------------------------------------
+
+def run_suite_workload(name, client):
+    opts = {"workload": name, "nodes": ["n1", "n2", "n3"],
+            "concurrency": 3, "ssh": {"dummy": True},
+            "time_limit": 5, "rate": 500, "ops_per_key": 60,
+            "ops": 120, "seed": 7}
+    test = etcd.etcd_test(opts)
+    # dummy infrastructure: no OS setup, no real DB, fake gateway, no
+    # nemesis schedule — the workload generator alone
+    from jepsen_tpu import db as jdb, os_setup
+    w = etcd.WORKLOADS[name](opts)
+    test["os"] = os_setup.noop
+    test["db"] = jdb.noop
+    test["client"] = client
+    test["nemesis"] = None
+    test["generator"] = gen.clients(w["generator"])
+    test["name"] = None
+    return core.run(test)
+
+
+def test_register_end_to_end_valid():
+    state = FakeEtcd()
+    t = run_suite_workload(
+        "register", etcd.EtcdRegisterClient(state.factory))
+    assert t["results"]["valid?"] is True
+
+
+def test_append_end_to_end_valid():
+    state = FakeEtcd()
+    t = run_suite_workload("append", etcd.EtcdAppendClient(state.factory))
+    assert t["results"]["valid?"] is True
+
+
+class BrokenHttp(FakeHttp):
+    """Loses every third write silently: a linearizability violation."""
+
+    def __init__(self, state):
+        super().__init__(state)
+
+    def put(self, key, value):
+        with self.state.lock:
+            self.state.n = getattr(self.state, "n", 0) + 1
+            if self.state.n % 3 == 0:
+                return  # dropped write acked as ok
+            self.state.kv[key] = value
+
+
+def test_register_end_to_end_catches_lost_writes():
+    state = FakeEtcd()
+    t = run_suite_workload(
+        "register",
+        etcd.EtcdRegisterClient(lambda n: BrokenHttp(state)))
+    assert t["results"]["valid?"] is False
