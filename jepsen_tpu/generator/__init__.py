@@ -189,6 +189,14 @@ class Seq(Generator):
                 current = Seq._FRESH
                 continue
             o, g2 = res
+            # Tail flattening: a Seq at its final element is equivalent
+            # to that element's continuation. Returning it bare keeps
+            # fn-generator chains (Seq([g, fn]) rebuilt per call) at
+            # constant depth instead of nesting once per exhaustion,
+            # which blew the recursion limit past ~400 consumed ops.
+            if (not isinstance(self.items, _LazyList)
+                    and i == len(self.items) - 1):
+                return o, g2
             return o, Seq(self.items, i, g2)
 
     def update(self, test, ctx, event):

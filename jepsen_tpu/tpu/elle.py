@@ -103,6 +103,10 @@ class AppendAnalysis:
         self.edges = self._edges()
 
     def _index_appends(self):
+        # Writers that may have committed (:ok, or :info indeterminate —
+        # a cycle through an unexecuted :info writer can't close, since
+        # its outgoing edges all require its values to be observed).
+        self.writers_by_key: dict = defaultdict(dict)
         for t in self.txns:
             per_key: dict = defaultdict(list)
             for mop in t.mops:
@@ -110,6 +114,8 @@ class AppendAnalysis:
                 if f == "append":
                     per_key[k].append(v)
             for k, vs in per_key.items():
+                if t.type != h.FAIL:
+                    self.writers_by_key[k][t.i] = t
                 for j, v in enumerate(vs):
                     key = (k, _freeze(v))
                     prev = self.writer.get(key)
@@ -194,22 +200,55 @@ class AppendAnalysis:
         for k, sp in self.spine.items():
             for a, b in zip(sp, sp[1:]):
                 nxt[(k, _freeze(a))] = b
+        # Targets for empty-read anti-dependencies, one set per key:
+        # the first spine writer (the rest of the spine is reachable
+        # from it via the ww chain) plus every possibly-committed
+        # writer none of whose appends made the observed spine.
+        empty_targets: dict = {}
+
+        def _targets(k):
+            ts = empty_targets.get(k)
+            if ts is None:
+                ts = {}
+                spine_writers = set()
+                for v in self.spine.get(k) or []:
+                    w = self.writer.get((k, _freeze(v)))
+                    if w is not None and w[0].type != h.FAIL:
+                        if not spine_writers:
+                            ts[w[0].i] = w[0]
+                        spine_writers.add(w[0].i)
+                for wt in self.writers_by_key.get(k, {}).values():
+                    if wt.i not in spine_writers:
+                        ts[wt.i] = wt
+                empty_targets[k] = ts
+            return ts
+
         for t, k, vs in self._reads():
             if vs:
-                w = self.writer.get((k, _freeze(vs[-1])))
+                last = _freeze(vs[-1])
+                w = self.writer.get((k, last))
                 if (w is not None and w[0].i != t.i
                         and w[0].type != h.FAIL):
                     edges.append((w[0].i, t.i, WR))
-            # anti-dependency: reader -> writer of the next version
-            nv = (nxt.get((k, _freeze(vs[-1]))) if vs
-                  else (self.spine.get(k) or [None])[0])
-            if nv is not None:
-                w = self.writer.get((k, _freeze(nv)))
-                if (w is not None and w[0].i != t.i
-                        and w[0].type != h.FAIL):
-                    edges.append((t.i, w[0].i, RW))
+                # anti-dependency: reader -> writer of the next version
+                nv = nxt.get((k, last))
+                if nv is not None:
+                    w = self.writer.get((k, _freeze(nv)))
+                    if (w is not None and w[0].i != t.i
+                            and w[0].type != h.FAIL):
+                        edges.append((t.i, w[0].i, RW))
+            else:
+                # An external read of [] precedes EVERY install on this
+                # key: in any serial order consistent with it, t runs
+                # before each committed appender (else t would observe
+                # its value). This also covers keys no read ever
+                # observed, which the spine-based path used to miss
+                # (round-2 advisor finding).
+                for wt in _targets(k).values():
+                    if wt.i != t.i:
+                        edges.append((t.i, wt.i, RW))
         edges.extend(_order_edges(committed))
-        return edges
+        return list(dict.fromkeys(edges))
 
 
 def _order_edges(committed: list[Txn]) -> list[tuple[int, int, int]]:

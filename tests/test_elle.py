@@ -19,6 +19,12 @@ def T(*events):
                     for t, p, m in events])
 
 
+def H(*events):
+    """history of ops from (type, process, f, mops) tuples."""
+    return History([op(type=t, process=p, f=f, value=m)
+                    for t, p, f, m in events])
+
+
 def ok_txns(*pairs):
     """Interleave invoke/ok pairs sequentially: each pair is
     (process, invoked_mops, completed_mops)."""
@@ -318,3 +324,31 @@ class TestFullRealtime:
             for a, b in itertools.permutations(txns, 2):
                 if a.complete_pos < b.invoke_pos:
                     assert b.i in reach[a.i], (a.i, b.i)
+
+
+class TestEmptyReadRw:
+    def test_empty_read_rw_edge_to_info_writer(self):
+        """An :info append later observed by a read is provably
+        committed; an empty read of that key must still produce the rw
+        anti-dependency (round-3 review finding)."""
+        hist = T(
+            ("invoke", 0, [["append", "k", 1]]),
+            ("info", 0, [["append", "k", 1]]),     # indeterminate...
+            ("invoke", 1, [["r", "k", None]]),
+            ("ok", 1, [["r", "k", [1]]]),          # ...but observed
+            ("invoke", 2, [["r", "k", None]]),
+            ("ok", 2, [["r", "k", []]]))           # missed k=1: cycle
+        res = elle.check_list_append(hist)
+        assert res["valid?"] is False
+        assert any(t.endswith("-realtime") for t in res["anomaly-types"]), res
+
+    def test_empty_read_before_writer_is_valid(self):
+        hist = T(
+            ("invoke", 0, [["r", "k", None]]),
+            ("ok", 0, [["r", "k", []]]),
+            ("invoke", 1, [["append", "k", 1]]),
+            ("ok", 1, [["append", "k", 1]]),
+            ("invoke", 2, [["r", "k", None]]),
+            ("ok", 2, [["r", "k", [1]]]))
+        res = elle.check_list_append(hist)
+        assert res["valid?"] is True, res

@@ -393,3 +393,27 @@ def test_nemesis_route():
     g = gen.nemesis(gen.limit(3, gen.repeat({"f": "break"})))
     ops = gt.perfect(g)
     assert all(o.process == "nemesis" for o in ops)
+
+
+def test_fn_generator_constant_depth():
+    """Fn generators re-invoked thousands of times must not accumulate
+    nested Seq continuations (blew the recursion limit past ~400 ops
+    before tail flattening)."""
+    import sys
+
+    n = 0
+
+    def fn():
+        return {"f": "w", "value": n}
+
+    import inspect
+
+    limit = sys.getrecursionlimit()
+    try:
+        # fixed headroom above the *current* depth, so harness stack
+        # depth (pytest plugins, coverage, ...) can't starve the budget
+        sys.setrecursionlimit(len(inspect.stack()) + 180)
+        ops = gt.quick(gen.limit(3000, fn))
+    finally:
+        sys.setrecursionlimit(limit)
+    assert len(ops) == 3000

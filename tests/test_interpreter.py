@@ -135,14 +135,16 @@ def test_core_run_cas_register_e2e():
     meta_log: list = []
     import random
 
+    rng = random.Random(42)  # unseeded draws made all-cas-fail possible
+
     def rand_op():
-        r = random.random()
+        r = rng.random()
         if r < 0.4:
             return {"f": "read"}
         if r < 0.7:
-            return {"f": "write", "value": random.randint(0, 4)}
-        return {"f": "cas", "value": [random.randint(0, 4),
-                                      random.randint(0, 4)]}
+            return {"f": "write", "value": rng.randint(0, 4)}
+        return {"f": "cas", "value": [rng.randint(0, 4),
+                                      rng.randint(0, 4)]}
 
     t = base_test(
         nodes=["n1", "n2", "n3"],
