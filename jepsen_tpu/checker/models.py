@@ -35,6 +35,20 @@ def is_inconsistent(m) -> bool:
     return isinstance(m, Inconsistent)
 
 
+def _hfreeze(v):
+    """Hashable view of a model state value: ops may carry lists/dicts
+    (e.g. a list written into a Register), and model states built from
+    them must still hash for search-state dedup."""
+    if isinstance(v, (list, tuple)):
+        return tuple(_hfreeze(x) for x in v)
+    if isinstance(v, (set, frozenset)):
+        return frozenset(_hfreeze(x) for x in v)
+    if isinstance(v, dict):
+        return tuple(sorted(((k, _hfreeze(x)) for k, x in v.items()),
+                            key=repr))
+    return v
+
+
 class Model:
     """A sequential datatype: step(op) -> next model | Inconsistent.
 
@@ -53,8 +67,9 @@ class Model:
         return type(self) is type(other) and self.__dict__ == other.__dict__
 
     def __hash__(self):
-        return hash((type(self).__name__, tuple(sorted(
-            self.__dict__.items(), key=lambda kv: kv[0]))))
+        return hash((type(self).__name__, tuple(
+            (k, _hfreeze(v))
+            for k, v in sorted(self.__dict__.items()))))
 
 
 class NoOp(Model):

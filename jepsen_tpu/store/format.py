@@ -61,9 +61,16 @@ class HistoryWriter:
     def __init__(self, path: Path):
         self.path = Path(path)
         self.path.parent.mkdir(parents=True, exist_ok=True)
-        fresh = not self.path.exists()
+        if self.path.exists() and self.path.stat().st_size > 0:
+            # Reopening after a crash: cut the file back to its last
+            # intact record, or appends would land after a torn tail
+            # and be silently dropped by the recovering reader.
+            end = _valid_prefix_end(self.path)
+            if end < self.path.stat().st_size:
+                with open(self.path, "r+b") as f:
+                    f.truncate(end)
         self._f = open(self.path, "ab")
-        if fresh or self._f.tell() == 0:
+        if self._f.tell() == 0:
             self._f.write(MAGIC)
             self._f.flush()
         self._count = 0
@@ -85,20 +92,43 @@ class HistoryWriter:
         return list(read_ops(self.path))
 
 
+def _scan_records(f) -> Iterator[tuple[bytes, int]]:
+    """Walks intact CRC-framed records from just after the magic,
+    yielding (payload, end_offset) and stopping at a torn/corrupt tail.
+    The single framing walker behind both reads and reopen-truncation,
+    so the writer can never truncate what the reader would accept."""
+    end = len(MAGIC)
+    while True:
+        hdr = f.read(_HDR.size)
+        if len(hdr) < _HDR.size:
+            return  # clean EOF or torn header
+        n, crc = _HDR.unpack(hdr)
+        payload = f.read(n)
+        if len(payload) < n or zlib.crc32(payload) != crc:
+            return  # torn/corrupt tail: drop and recover
+        end += _HDR.size + n
+        yield payload, end
+
+
+def _valid_prefix_end(path) -> int:
+    """Byte offset just past the last intact record (0 if even the
+    magic is bad, so the writer restarts the file)."""
+    with open(path, "rb") as f:
+        if f.read(len(MAGIC)) != MAGIC:
+            return 0
+        end = len(MAGIC)
+        for _payload, end in _scan_records(f):
+            pass
+        return end
+
+
 def read_ops(path) -> Iterator[Op]:
     """Reads ops, tolerating a torn tail (crash recovery)."""
     path = Path(path)
     with open(path, "rb") as f:
         if f.read(len(MAGIC)) != MAGIC:
             raise ValueError(f"{path}: bad magic")
-        while True:
-            hdr = f.read(_HDR.size)
-            if len(hdr) < _HDR.size:
-                return  # clean EOF or torn header
-            n, crc = _HDR.unpack(hdr)
-            payload = f.read(n)
-            if len(payload) < n or zlib.crc32(payload) != crc:
-                return  # torn/corrupt tail: drop and recover
+        for payload, _end in _scan_records(f):
             yield decode_op(payload)
 
 

@@ -48,6 +48,20 @@ BIG = int(INF)
 # Host search (unbounded window; correctness reference and fallback)
 # ---------------------------------------------------------------------------
 
+def _min_ret(p: int, wmask: int, m, sufmin, ret_t) -> int:
+    """Earliest completion among unlinearized entries at config (p,
+    wmask): the candidate cutoff — only entries invoked before it may
+    linearize next. Shared by the searches and witness extraction."""
+    span = wmask.bit_length()
+    mr = int(sufmin[min(p + span, m)])
+    for i in range(span):
+        if not (wmask >> i) & 1 and p + i < m:
+            r = int(ret_t[p + i])
+            if r < mr:
+                mr = r
+    return mr
+
+
 def search_host(enc: Encoded, witness: bool = False) -> dict:
     """Exhaustive WGL over an Encoded history. Returns {'valid?': bool}
     plus witness info (furthest entry reached, pending ops, states) when
@@ -78,14 +92,7 @@ def search_host(enc: Encoded, witness: bool = False) -> dict:
             best_p, best_cfgs = p, []
         if p == best_p and len(best_cfgs) < 8:
             best_cfgs.append((p, wmask, st))
-        # min completion among unlinearized entries
-        span = wmask.bit_length()
-        min_ret = int(sufmin[min(p + span, m)])
-        for i in range(span):
-            if not (wmask >> i) & 1 and p + i < m:
-                r = int(ret_t[p + i])
-                if r < min_ret:
-                    min_ret = r
+        min_ret = _min_ret(p, wmask, m, sufmin, ret_t)
         # candidates: unlinearized j with inv_t[j] < min_ret (inv_t sorted)
         i = 0
         while p + i < m and int(inv_t[p + i]) < min_ret:
@@ -113,9 +120,18 @@ def search_host(enc: Encoded, witness: bool = False) -> dict:
         out["op"] = enc.entry_ops[best_p] if best_p < m else None
         cfgs = []
         for p, wmask, st in best_cfgs:
-            pending = [enc.entry_ops[p + i]
-                       for i in range(wmask.bit_length() + 1)
-                       if p + i < m and not (wmask >> i) & 1][:4]
+            # pending = every unlinearized entry in flight at the stuck
+            # point: invoked before the earliest completion among
+            # unlinearized entries (can lie well past the mask span).
+            min_ret = _min_ret(p, wmask, m, sufmin, ret_t)
+            pending = []
+            i = 0
+            while p + i < m and int(inv_t[p + i]) < min_ret:
+                if not (wmask >> i) & 1:
+                    pending.append(enc.entry_ops[p + i])
+                    if len(pending) >= 4:
+                        break
+                i += 1
             cfgs.append({"model": enc.states[st], "pending": pending})
         out["configs"] = cfgs
         out["previous-ok"] = enc.entry_ops[best_p - 1] if best_p else None
@@ -140,13 +156,7 @@ def search_host_reach(enc: Encoded) -> int:
         if p >= m:
             out |= 1 << st
             continue
-        span = wmask.bit_length()
-        min_ret = int(sufmin[min(p + span, m)])
-        for i in range(span):
-            if not (wmask >> i) & 1 and p + i < m:
-                r = int(ret_t[p + i])
-                if r < min_ret:
-                    min_ret = r
+        min_ret = _min_ret(p, wmask, m, sufmin, ret_t)
         i = 0
         while p + i < m and int(inv_t[p + i]) < min_ret:
             if not (wmask >> i) & 1:

@@ -110,3 +110,29 @@ def test_crashed_lifecycle_releases_log_handler(tmp_path):
     except Exception:
         pass
     assert len(logging.getLogger().handlers) == before
+
+
+def test_history_log_reopen_truncates_torn_tail(tmp_path):
+    """Reopening a crashed log must cut back to the last intact record,
+    or new appends land after the torn tail and vanish on read
+    (round-2 advisor finding)."""
+    p = tmp_path / "history.jlog"
+    w = fmt.HistoryWriter(p)
+    for i in range(10):
+        w.append(op(index=i, type="ok", process=0, f="read", value=i))
+    w.close()
+    with open(p, "r+b") as f:  # crash mid-record
+        f.truncate(p.stat().st_size - 5)
+    w2 = fmt.HistoryWriter(p)
+    w2.append(op(index=100, type="ok", process=1, f="read", value=100))
+    back = w2.read_back()
+    assert len(back) == 10  # 9 intact + 1 new; none silently lost
+    assert back[-1].value == 100
+
+
+def test_history_log_reopen_bad_magic_restarts(tmp_path):
+    p = tmp_path / "history.jlog"
+    p.write_bytes(b"garbage")
+    w = fmt.HistoryWriter(p)
+    w.append(op(index=0, type="ok", process=0, f="read", value=1))
+    assert [o.value for o in w.read_back()] == [1]

@@ -330,3 +330,36 @@ def test_non_tabulable_model_uses_object_search():
     a = wgl.analysis(ProcessMutex(), hist)
     assert a["analyzer"] == "model"
     assert a["valid?"] is False  # p1 releasing p0's lock
+
+
+# ---------------------------------------------------------------------------
+# Round-3 advisor regressions
+# ---------------------------------------------------------------------------
+
+def test_unhashable_op_values_check_cleanly():
+    """A list written into a register must not blow up state hashing and
+    degrade the whole result to unknown (round-2 advisor finding)."""
+    hist = H(("invoke", 0, "write", [1, 2]), ("ok", 0, "write", [1, 2]),
+             ("invoke", 1, "read", None), ("ok", 1, "read", [1, 2]))
+    for alg in ("tpu", "wgl", "model"):
+        a = wgl.analysis(model.register(), hist, algorithm=alg)
+        assert a["valid?"] is True, (alg, a)
+    bad = H(("invoke", 0, "write", [1, 2]), ("ok", 0, "write", [1, 2]),
+            ("invoke", 1, "read", None), ("ok", 1, "read", [9]))
+    for alg in ("tpu", "wgl", "model"):
+        a = wgl.analysis(model.register(), bad, algorithm=alg)
+        assert a["valid?"] is False, (alg, a)
+
+
+def test_witness_pending_reaches_past_mask_span():
+    """All in-flight ops at the stuck point belong in the witness
+    pending list, not just offsets inside the linearized-mask span
+    (round-2 advisor finding: the scan stopped at bit_length()+1)."""
+    hist = H(
+        ("invoke", 0, "read", 5), ("invoke", 1, "read", 6),
+        ("invoke", 2, "read", 7),
+        ("ok", 0, "read", 5), ("ok", 1, "read", 6), ("ok", 2, "read", 7))
+    a = wgl.analysis(model.cas_register(), hist, algorithm="wgl")
+    assert a["valid?"] is False
+    pend = a["configs"][0]["pending"]
+    assert len(pend) == 3, a["configs"]
