@@ -251,40 +251,55 @@ class AppendAnalysis:
         return list(dict.fromkeys(edges))
 
 
-def _order_edges(committed: list[Txn]) -> list[tuple[int, int, int]]:
+def order_edge_arrays(committed: list[Txn]):
     """Process chains (session order per process) plus the FULL
     realtime interval order, reduced: a time sweep keeps a covering
     frontier of completed txns, so A reaches B by realtime edges iff
     A completed before B invoked — exactly elle's realtime relation,
-    with O(n * concurrency) edges instead of O(n^2)."""
-    edges = []
+    with O(n * concurrency) edges instead of O(n^2). Returns int
+    (src, dst, type) arrays; the single implementation behind both the
+    host and device engines."""
+    src: list[int] = []
+    dst: list[int] = []
+    ty: list[int] = []
     by_proc: dict = defaultdict(list)
     for t in committed:
         by_proc[t.process].append(t)
     for ts in by_proc.values():
         ts.sort(key=lambda t: t.invoke_pos)
         for a, b in zip(ts, ts[1:]):
-            edges.append((a.i, b.i, PROC))
+            src.append(a.i)
+            dst.append(b.i)
+            ty.append(PROC)
     # Sweep events in history order. On a completion, drop frontier
     # members the completing txn already covers (their completion
     # precedes its invocation, so an edge to it was emitted at its
     # invoke); on an invocation, link every frontier member in.
     events = []
     for t in committed:
-        events.append((t.invoke_pos, t))
-        events.append((t.complete_pos, t))
-    events.sort(key=lambda e: e[0])
+        events.append((t.invoke_pos, 1, t))
+        events.append((t.complete_pos, 0, t))
+    events.sort(key=lambda e: (e[0], e[1]))
     frontier: list[Txn] = []
-    for pos, t in events:
-        if pos == t.invoke_pos:
+    for pos, is_inv, t in events:
+        if is_inv:
             for a in frontier:
                 if a.i != t.i:
-                    edges.append((a.i, t.i, RT))
+                    src.append(a.i)
+                    dst.append(t.i)
+                    ty.append(RT)
         else:
             frontier[:] = [y for y in frontier
                            if y.complete_pos >= t.invoke_pos]
             frontier.append(t)
-    return edges
+    return (np.asarray(src, dtype=np.int64),
+            np.asarray(dst, dtype=np.int64),
+            np.asarray(ty, dtype=np.int64))
+
+
+def _order_edges(committed: list[Txn]) -> list[tuple[int, int, int]]:
+    src, dst, ty = order_edge_arrays(committed)
+    return [(int(a), int(b), int(c)) for a, b, c in zip(src, dst, ty)]
 
 
 # ---------------------------------------------------------------------------
@@ -400,11 +415,31 @@ def cycle_anomalies(n: int, edges, txns) -> dict[str, list]:
 # Public checks
 # ---------------------------------------------------------------------------
 
+# Histories at least this many ops take the interned-array device
+# engine (elle_device) under engine="auto"; below it, flat-Python
+# wins on constant factors.
+_DEVICE_MIN_OPS = 4000
+
+
 def check_list_append(hist, opts: dict | None = None) -> dict:
     """elle.list-append/check equivalent: infers the dependency graph
-    from append/read txns and reports anomalies."""
+    from append/read txns and reports anomalies.
+
+    opts["engine"]: "host" (this module's reference implementation),
+    "device" (interned arrays + batched SCC, jepsen_tpu.tpu.elle_device),
+    or "auto" (default: device for large histories, host otherwise;
+    non-internable histories always fall back to host)."""
     if not isinstance(hist, History):
         hist = History(hist)
+    engine = (opts or {}).get("engine", "auto")
+    if engine == "device" or (engine == "auto"
+                              and len(hist) >= _DEVICE_MIN_OPS):
+        from . import elle_device
+        try:
+            return elle_device.check_list_append_device(hist)
+        except elle_device.Unvectorizable:
+            if engine == "device":
+                raise
     a = AppendAnalysis(hist)
     anomalies = dict(a.anomalies)
     for name, ws in cycle_anomalies(len(a.txns), a.edges,

@@ -1,0 +1,257 @@
+"""Differential tests: the device-path list-append engine
+(tpu/elle_device) must agree with the host reference engine
+(tpu/elle) on every fixture and on randomized valid/corrupted
+histories, mirroring how the reference treats elle as ground truth
+(jepsen/src/jepsen/tests/cycle/append.clj)."""
+
+import random
+
+import numpy as np
+import pytest
+
+from jepsen_tpu.history import History, op
+from jepsen_tpu.tpu import elle, elle_device, scc as scc_mod
+
+
+def T(*events):
+    return History([op(type=t, process=p, f="txn", value=m)
+                    for t, p, m in events])
+
+
+FIXTURES = {
+    "valid_seq": T(
+        ("invoke", 0, [["append", "x", 1]]), ("ok", 0, [["append", "x", 1]]),
+        ("invoke", 1, [["r", "x", None]]), ("ok", 1, [["r", "x", [1]]]),
+        ("invoke", 0, [["append", "x", 2]]), ("ok", 0, [["append", "x", 2]]),
+        ("invoke", 1, [["r", "x", None]]), ("ok", 1, [["r", "x", [1, 2]]])),
+    "g0": T(("invoke", 0, [["append", "x", 1], ["append", "y", 1]]),
+            ("invoke", 1, [["append", "x", 2], ["append", "y", 2]]),
+            ("ok", 0, [["append", "x", 1], ["append", "y", 1]]),
+            ("ok", 1, [["append", "x", 2], ["append", "y", 2]]),
+            ("invoke", 2, [["r", "x", None], ["r", "y", None]]),
+            ("ok", 2, [["r", "x", [1, 2]], ["r", "y", [2, 1]]])),
+    "g1a": T(("invoke", 0, [["append", "x", 9]]),
+             ("fail", 0, [["append", "x", 9]]),
+             ("invoke", 1, [["r", "x", None]]),
+             ("ok", 1, [["r", "x", [9]]])),
+    "g1b": T(("invoke", 0, [["append", "x", 1], ["append", "x", 2]]),
+             ("ok", 0, [["append", "x", 1], ["append", "x", 2]]),
+             ("invoke", 1, [["r", "x", None]]),
+             ("ok", 1, [["r", "x", [1]]])),
+    "g1c": T(("invoke", 0, [["append", "x", 1], ["r", "y", None]]),
+             ("invoke", 1, [["append", "y", 1], ["r", "x", None]]),
+             ("ok", 0, [["append", "x", 1], ["r", "y", [1]]]),
+             ("ok", 1, [["append", "y", 1], ["r", "x", [1]]])),
+    "g_single": T(("invoke", 0, [["r", "x", None], ["r", "y", None]]),
+                  ("invoke", 1, [["append", "y", 1], ["append", "x", 1]]),
+                  ("ok", 1, [["append", "y", 1], ["append", "x", 1]]),
+                  ("ok", 0, [["r", "x", []], ["r", "y", [1]]]),
+                  ("invoke", 2, [["r", "x", None]]),
+                  ("ok", 2, [["r", "x", [1]]])),
+    "g2": T(("invoke", 0, [["r", "x", None], ["append", "y", 1]]),
+            ("invoke", 1, [["r", "y", None], ["append", "x", 1]]),
+            ("ok", 0, [["r", "x", []], ["append", "y", 1]]),
+            ("ok", 1, [["r", "y", []], ["append", "x", 1]]),
+            ("invoke", 2, [["r", "x", None], ["r", "y", None]]),
+            ("ok", 2, [["r", "x", [1]], ["r", "y", [1]]])),
+    "incompat": T(("invoke", 0, [["r", "x", None]]),
+                  ("ok", 0, [["r", "x", [1, 2]]]),
+                  ("invoke", 1, [["r", "x", None]]),
+                  ("ok", 1, [["r", "x", [2, 1, 3]]])),
+    "internal": T(("invoke", 0, [["append", "x", 5], ["r", "x", None]]),
+                  ("ok", 0, [["append", "x", 5], ["r", "x", [1]]])),
+    "dup": T(("invoke", 0, [["append", "x", 1]]),
+             ("ok", 0, [["append", "x", 1]]),
+             ("invoke", 1, [["append", "x", 1]]),
+             ("ok", 1, [["append", "x", 1]])),
+    "retry_after_fail": T(
+        ("invoke", 0, [["append", "x", 1]]), ("fail", 0, [["append", "x", 1]]),
+        ("invoke", 0, [["append", "x", 1]]), ("ok", 0, [["append", "x", 1]]),
+        ("invoke", 1, [["r", "x", None]]), ("ok", 1, [["r", "x", [1]]])),
+    "info_observed": T(
+        ("invoke", 0, [["append", "x", 1]]), ("info", 0, [["append", "x", 1]]),
+        ("invoke", 1, [["r", "x", None]]), ("ok", 1, [["r", "x", [1]]])),
+    "empty_read_info": T(
+        ("invoke", 0, [["append", "k", 1]]), ("info", 0, [["append", "k", 1]]),
+        ("invoke", 1, [["r", "k", None]]), ("ok", 1, [["r", "k", [1]]]),
+        ("invoke", 2, [["r", "k", None]]), ("ok", 2, [["r", "k", []]])),
+    "rt_beyond": T(
+        ("invoke", 1, [["append", "z", 1]]), ("invoke", 0, [["append", "y", 1]]),
+        ("ok", 0, [["append", "y", 1]]), ("ok", 1, [["append", "z", 1]]),
+        ("invoke", 2, [["r", "y", None]]), ("ok", 2, [["r", "y", []]])),
+    "empty": T(),
+    "no_appends": T(("invoke", 0, [["r", "x", None]]),
+                    ("ok", 0, [["r", "x", []]])),
+}
+
+
+@pytest.mark.parametrize("name", sorted(FIXTURES))
+def test_fixture_matches_host(name):
+    hist = FIXTURES[name]
+    rh = elle.check_list_append(hist, {"engine": "host"})
+    rd = elle_device.check_list_append_device(hist)
+    assert rh["valid?"] == rd["valid?"], (rh, rd)
+    assert rh["anomaly-types"] == rd["anomaly-types"], (rh, rd)
+
+
+def gen_history(rng, n_txns, n_keys=8, max_len=4, rotate=24):
+    """Concurrent valid-by-construction list-append history with
+    ok/fail/info completions and key rotation."""
+    store = {}
+    epoch = [0]
+    events = []
+    open_t = {}
+    procs = list(range(5))
+    t_count = 0
+    nv = [1]
+    while t_count < n_txns or open_t:
+        idle = [p for p in procs if p not in open_t]
+        if t_count < n_txns and idle and (rng.random() < 0.6
+                                          or not open_t):
+            p = rng.choice(idle)
+            txn = []
+            for _ in range(rng.randint(1, max_len)):
+                k = f"k{rng.randrange(n_keys)}e{epoch[0]}"
+                if rng.random() < 0.5:
+                    txn.append(["append", k, nv[0]])
+                    nv[0] += 1
+                else:
+                    txn.append(["r", k, None])
+            events.append(("invoke", p, txn))
+            open_t[p] = txn
+            t_count += 1
+            if t_count % rotate == 0:
+                epoch[0] += 1
+        else:
+            p = rng.choice(list(open_t))
+            txn = open_t.pop(p)
+            r = rng.random()
+            if r < 0.85:
+                res = []
+                for f, k, v in txn:
+                    if f == "append":
+                        store.setdefault(k, []).append(v)
+                        res.append(["append", k, v])
+                    else:
+                        res.append(["r", k, list(store.get(k, []))])
+                events.append(("ok", p, res))
+            elif r < 0.95:
+                events.append(("fail", p, txn))
+            else:
+                if rng.random() < 0.5:
+                    for f, k, v in txn:
+                        if f == "append":
+                            store.setdefault(k, []).append(v)
+                events.append(("info", p, txn))
+    return [op(type=t, process=p, f="txn", value=m)
+            for t, p, m in events]
+
+
+def corrupt(rng, ops):
+    """Damage one committed read to seed an anomaly."""
+    ops = [op(**o.to_dict()) for o in ops]
+    mode = rng.choice(["drop_elem", "swap", "phantom", "truncate"])
+    oks = [i for i, o in enumerate(ops)
+           if o.type == "ok" and any(m[0] == "r" and m[2]
+                                     for m in (o.value or []))]
+    if not oks:
+        return ops
+    i = rng.choice(oks)
+    v = [list(m) for m in ops[i].value]
+    for m in v:
+        if m[0] == "r" and m[2]:
+            lst = list(m[2])
+            if mode == "drop_elem" and len(lst) > 1:
+                del lst[rng.randrange(len(lst) - 1)]
+            elif mode == "swap" and len(lst) > 1:
+                a, b = rng.sample(range(len(lst)), 2)
+                lst[a], lst[b] = lst[b], lst[a]
+            elif mode == "phantom":
+                lst.append(999999999)
+            elif mode == "truncate" and len(lst) > 1:
+                lst = lst[:-1]
+            m[2] = lst
+            break
+    ops[i] = op(**{**ops[i].to_dict(), "value": v})
+    return ops
+
+
+def test_random_differential():
+    rng = random.Random(11)
+    for trial in range(25):
+        ops = gen_history(rng, rng.choice([20, 60, 150]))
+        if trial % 2 == 1:
+            ops = corrupt(rng, ops)
+        h = History(ops)
+        rh = elle.check_list_append(h, {"engine": "host"})
+        rd = elle_device.check_list_append_device(h)
+        assert rh["valid?"] == rd["valid?"], (trial, rh, rd)
+        assert rh["anomaly-types"] == rd["anomaly-types"], (trial, rh, rd)
+
+
+def test_auto_engine_dispatch():
+    """auto uses device for big histories, host for small; both agree;
+    non-internable values fall back to host silently."""
+    rng = random.Random(2)
+    ops = gen_history(rng, 40)
+    small = elle.check_list_append(History(ops))
+    assert small["valid?"] is True
+    weird = T(("invoke", 0, [["append", "x", "not-an-int"]]),
+              ("ok", 0, [["append", "x", "not-an-int"]]),
+              ("invoke", 1, [["r", "x", None]]),
+              ("ok", 1, [["r", "x", ["not-an-int"]]]))
+    res = elle.check_list_append(weird, {"engine": "auto"})
+    assert res["valid?"] is True
+    with pytest.raises(elle_device.Unvectorizable):
+        elle.check_list_append(weird, {"engine": "device"})
+
+
+def test_scc_kernel_matches_host_random():
+    rng = np.random.default_rng(5)
+    prev = scc_mod.DEVICE_MIN_EDGES
+    scc_mod.DEVICE_MIN_EDGES = 1  # force the device path at test sizes
+    try:
+        for _ in range(15):
+            n = 150
+            e = rng.integers(0, n, size=(300, 2))
+            d = scc_mod.scc(n, e[:, 0], e[:, 1], device=True)
+            h = scc_mod._scc_host(n, e[:, 0], e[:, 1])
+            assert (d == h).all()
+    finally:
+        scc_mod.DEVICE_MIN_EDGES = prev
+
+
+def test_scc_edge_mask_subsets():
+    src = np.array([0, 1, 2, 3])
+    dst = np.array([1, 0, 3, 2])
+    mask1 = np.array([True, True, False, False])
+    labels = scc_mod.scc(4, src, dst, emask=mask1, device=False)
+    groups = scc_mod.nontrivial_from_labels(labels)
+    assert [sorted(g.tolist()) for g in groups] == [[0, 1]]
+
+
+def test_scc_adversarial_chain_falls_back():
+    """A decreasing chain exceeds the sweep cap on device; the host
+    fallback must still give exact singleton labels."""
+    n = 3000
+    src = np.arange(n - 1, 0, -1)
+    dst = np.arange(n - 2, -1, -1)
+    labels = scc_mod.scc(n, src, dst, device=True)
+    assert (labels == np.arange(n)).all()
+
+
+def test_unobservable_last_element_still_gets_rw():
+    """The anti-dependency is keyed by raw value (host nxt dict), so it
+    must fire even when the read's last element has no writer
+    (round-3 review finding: the pid-based lookup dropped the edge)."""
+    hist = T(
+        ("invoke", 0, [["append", "x", 1]]), ("ok", 0, [["append", "x", 1]]),
+        ("invoke", 1, [["append", "x", 2]]), ("ok", 1, [["append", "x", 2]]),
+        ("invoke", 2, [["r", "x", None]]),
+        ("ok", 2, [["r", "x", [1, 999, 2]]]),   # 999 never appended
+        ("invoke", 3, [["r", "x", None]]),
+        ("ok", 3, [["r", "x", [1, 999]]]))
+    rh = elle.check_list_append(hist, {"engine": "host"})
+    rd = elle_device.check_list_append_device(hist)
+    assert rh["valid?"] == rd["valid?"]
+    assert rh["anomaly-types"] == rd["anomaly-types"], (rh, rd)
