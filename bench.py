@@ -1,69 +1,212 @@
 #!/usr/bin/env python
-"""Headline benchmark: linearizability-check throughput on a 1M-event
-CAS-register history (BASELINE.md north-star config 2: check in < 60 s;
-the reference's knossos CPU checker times out at this scale).
+"""Benchmarks against BASELINE.md's north-star configs.
 
-Prints ONE JSON line:
-  {"metric": ..., "value": ops/sec checked, "unit": "ops/s",
-   "vs_baseline": speedup vs the 60 s target}
+Headline (printed LAST, the line the driver records):
+  config 2 — linearizability-check throughput on a 1M-event CAS-register
+  history (< 60 s target on TPU; the reference's knossos CPU checker
+  times out at this scale). Timed region: encode -> segmented device
+  check, median of 3 runs so one noisy run can't flip the artifact
+  (round-2 verdict: the single-shot bench recorded a below-baseline
+  outlier).
 
-Timed region: history -> encode -> device check (the full checking
-pipeline a test run would execute after the interpreter finishes).
-History generation is untimed setup. BENCH_OPS overrides the event count
-(e.g. BENCH_OPS=100000 for a smoke run on CPU).
+Also printed (one JSON line each, config 2 last):
+  config 3 — elle list-append dependency-cycle check, 100k txns
+             (device engine: interned arrays + batched SCC)
+  config 4 — bank balance-conservation check, 500k txns (array fold)
+  config 5 — 1024-history ensemble checked in one batched launch
+
+Baselines: config 2's is the 60 s target scaled to history size; the
+others use the host reference engines (pure-Python elle / per-op fold)
+measured in-process, so vs_baseline = host_time / device_time.
+
+BENCH_OPS scales config 2 (e.g. BENCH_OPS=100000 for a CPU smoke run);
+BENCH_SKIP_EXTRAS=1 runs the headline config only.
 """
 
 import json
 import os
+import statistics
 import sys
 import time
 
 
-def main():
-    n_events = int(os.environ.get("BENCH_OPS", "1000000"))
-    n_invocations = n_events // 2
-    target_s = 60.0 * (n_events / 1_000_000)  # baseline scales with size
+def _log(msg):
+    print(f"# {msg}", file=sys.stderr)
 
+
+def bench_list_append(n_txns=100_000):
+    from jepsen_tpu.tpu import elle, synth
+
+    t0 = time.time()
+    hist = synth.list_append_history(n_txns, seed=11)
+    _log(f"config3: generated {n_txns} txns in {time.time() - t0:.1f}s")
+    elle.check_list_append(hist)  # warm: XLA compile out of timed region
+    times = []
+    for _ in range(3):
+        t0 = time.time()
+        res = elle.check_list_append(hist)
+        times.append(time.time() - t0)
+    assert res["valid?"] is True, res
+    dev = statistics.median(times)
+    host_times = []
+    for _ in range(3):
+        t0 = time.time()
+        host = elle.check_list_append(hist, {"engine": "host"})
+        host_times.append(time.time() - t0)
+    host_s = statistics.median(host_times)
+    assert host["valid?"] is True
+    _log(f"config3: device {dev:.2f}s host {host_s:.2f}s")
+    return {
+        "metric": f"elle list-append cycle check ({n_txns // 1000}k txns)",
+        "value": round(n_txns / dev, 1),
+        "unit": "txns/s",
+        "vs_baseline": round(host_s / dev, 2),
+    }
+
+
+def bench_bank(n_txns=500_000):
+    from jepsen_tpu.tpu import synth
+    from jepsen_tpu.workloads import bank
+
+    t0 = time.time()
+    hist = synth.bank_history(n_txns, seed=11)
+    _log(f"config4: generated {n_txns} txns in {time.time() - t0:.1f}s")
+    total = 8 * 10
+    bank.check_fast(hist, total)  # warm
+    times = []
+    for _ in range(3):
+        t0 = time.time()
+        res = bank.check_fast(hist, total)
+        times.append(time.time() - t0)
+    assert res["valid?"] is True, res
+    dev = statistics.median(times)
+
+    # host baseline: the reference-shaped per-op fold
+    host_times = []
+    for _ in range(3):
+        t0 = time.time()
+        bad = 0
+        reads = 0
+        for op in hist:
+            if (op.type == "ok" and op.f == "read"
+                    and op.value is not None):
+                reads += 1
+                balances = list(op.value.values())
+                if sum(balances) != total or any(b < 0
+                                                 for b in balances):
+                    bad += 1
+        host_times.append(time.time() - t0)
+    host_s = statistics.median(host_times)
+    assert bad == 0 and reads == res["read-count"]
+    _log(f"config4: device {dev:.2f}s host-fold {host_s:.2f}s")
+    return {
+        "metric": f"bank balance-conservation check ({n_txns // 1000}k txns)",
+        "value": round(n_txns / dev, 1),
+        "unit": "txns/s",
+        "vs_baseline": round(host_s / dev, 2),
+    }
+
+
+def bench_ensemble(n_hists=1024, ops_each=400, crash_p=0.15):
+    """Crashed (:info) ops are where batched search pays: the host
+    search branches exponentially on indeterminate ops while the
+    kernel's discard action costs nothing extra."""
+    from jepsen_tpu.checker import models
+    from jepsen_tpu.tpu import synth, wgl
+
+    t0 = time.time()
+    hists = [synth.register_history(ops_each, n_procs=4, seed=1000 + i,
+                                    crash_p=crash_p)
+             for i in range(n_hists)]
+    total_ops = sum(len(h) for h in hists)
+    _log(f"config5: generated {n_hists} histories "
+         f"({total_ops} events) in {time.time() - t0:.1f}s")
+    model = models.cas_register()
+    wgl.analysis_batch(model, hists)  # warm this exact shape bucket
+    times = []
+    for _ in range(3):
+        t0 = time.time()
+        results = wgl.analysis_batch(model, hists)
+        times.append(time.time() - t0)
+    assert all(r["valid?"] for r in results)
+    dev = statistics.median(times)
+    # host baseline: exhaustive WGL search per history, on a sample
+    # (extrapolated — running all on host would dominate bench time)
+    from jepsen_tpu.tpu.encode import encode
+    sample = hists[:max(n_hists // 32, 8)]
+    t0 = time.time()
+    for h in sample:
+        wgl.search_host(encode(model, h))
+    host_s = (time.time() - t0) * (n_hists / len(sample))
+    _log(f"config5: {n_hists} histories device {dev:.2f}s "
+         f"host-extrapolated {host_s:.1f}s")
+    return {
+        "metric": f"ensemble linearizability ({n_hists} histories, "
+                  f"{ops_each} ops each, {int(crash_p * 100)}% crashes)",
+        "value": round(total_ops / dev, 1),
+        "unit": "ops/s",
+        "vs_baseline": round(host_s / dev, 2),
+    }
+
+
+def bench_headline(n_events):
+    """Config 2: 1M-event register history, segmented device check."""
     from jepsen_tpu.checker import models
     from jepsen_tpu.tpu import synth, wgl
     from jepsen_tpu.tpu.encode import encode
 
+    n_invocations = n_events // 2
+    target_s = 60.0 * (n_events / 1_000_000)
+
     t0 = time.time()
     hist = synth.register_history(n_invocations, n_procs=5, seed=42)
     n_events = len(hist)
-    gen_s = time.time() - t0
-    print(f"# generated {n_events} events in {gen_s:.1f}s",
-          file=sys.stderr)
-
-    t1 = time.time()
-    enc = encode(models.cas_register(), hist)
-    enc_s = time.time() - t1
+    _log(f"config2: generated {n_events} events in {time.time() - t0:.1f}s")
 
     # First check pays one-time XLA compilation (cached on disk across
-    # runs); report steady-state and note compile separately.
-    t_c = time.time()
+    # runs); report steady-state, note compile separately.
+    t0 = time.time()
+    enc = encode(models.cas_register(), hist)
     wgl.check_segmented(enc, target_len=2048)
-    first_s = time.time() - t_c
+    _log(f"config2: first check (incl. compile) {time.time() - t0:.2f}s")
 
-    t2 = time.time()
-    res = wgl.check_segmented(enc, target_len=2048)
-    if res is None:
-        res = {"valid?": bool(wgl.check_batch([enc])[0] == wgl.VALID)}
-    check_s = time.time() - t2
-    elapsed = enc_s + check_s
-    print(f"# first check (incl. compile) {first_s:.2f}s",
-          file=sys.stderr)
-
-    assert res["valid?"] is True, f"expected valid history: {res}"
-    print(f"# encode {enc_s:.2f}s  check {check_s:.2f}s  "
-          f"segments={res.get('segments')}  m={enc.m}", file=sys.stderr)
-    print(json.dumps({
+    times = []
+    for _ in range(3):
+        t1 = time.time()
+        enc = encode(models.cas_register(), hist)
+        res = wgl.check_segmented(enc, target_len=2048)
+        if res is None:
+            res = {"valid?": bool(wgl.check_batch([enc])[0] == wgl.VALID)}
+        times.append(time.time() - t1)
+        assert res["valid?"] is True, res
+    elapsed = statistics.median(times)
+    _log(f"config2: encode+check runs {['%.2f' % t for t in times]} "
+         f"median {elapsed:.2f}s segments={res.get('segments')} m={enc.m}")
+    return {
         "metric": "linearizability check throughput "
                   f"({n_events // 1000}k-event CAS register history)",
         "value": round(n_events / elapsed, 1),
         "unit": "ops/s",
         "vs_baseline": round(target_s / elapsed, 2),
-    }))
+    }
+
+
+def main():
+    n_events = int(os.environ.get("BENCH_OPS", "1000000"))
+    small = n_events < 1_000_000
+    lines = []
+    if not os.environ.get("BENCH_SKIP_EXTRAS"):
+        for fn, args in ((bench_list_append,
+                          (10_000 if small else 100_000,)),
+                         (bench_bank, (50_000 if small else 500_000,)),
+                         (bench_ensemble, (128 if small else 1024,))):
+            try:
+                lines.append(fn(*args))
+            except Exception as e:  # extras must never sink the headline
+                _log(f"{fn.__name__} failed: {e!r}")
+    lines.append(bench_headline(n_events))
+    for ln in lines:
+        print(json.dumps(ln))
 
 
 if __name__ == "__main__":

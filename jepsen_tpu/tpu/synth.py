@@ -78,3 +78,96 @@ def register_history(n_ops: int, n_procs: int = 5, seed: int = 0,
     ops = [op(index=i, time=i, type=t, process=p, f=f, value=v)
            for i, (t, p, f, v) in enumerate(events)]
     return History(ops, assign_indices=False)
+
+
+def list_append_history(n_txns: int, n_procs: int = 5, n_keys: int = 6,
+                        max_len: int = 4, rotate: int = 40,
+                        seed: int = 0) -> History:
+    """A valid concurrent list-append history: appends apply to a true
+    store at completion, reads return its current state; keys rotate
+    every `rotate` txns so read lists stay bounded (as elle's generator
+    does). BASELINE config 3 fodder."""
+    rng = random.Random(seed)
+    store: dict = {}
+    epoch = 0
+    events: list = []
+    open_t: dict[int, list] = {}
+    t_count = 0
+    nv = 1
+    while t_count < n_txns or open_t:
+        idle = n_procs - len(open_t)
+        if t_count < n_txns and idle and (rng.random() < 0.6
+                                          or not open_t):
+            p = rng.choice([q for q in range(n_procs)
+                            if q not in open_t])
+            txn = []
+            for _ in range(rng.randint(1, max_len)):
+                k = f"k{rng.randrange(n_keys)}e{epoch}"
+                if rng.random() < 0.5:
+                    txn.append(["append", k, nv])
+                    nv += 1
+                else:
+                    txn.append(["r", k, None])
+            events.append(("invoke", p, txn))
+            open_t[p] = txn
+            t_count += 1
+            if t_count % rotate == 0:
+                epoch += 1
+        else:
+            p = rng.choice(list(open_t))
+            txn = open_t.pop(p)
+            res = []
+            for f, k, v in txn:
+                if f == "append":
+                    store.setdefault(k, []).append(v)
+                    res.append(["append", k, v])
+                else:
+                    res.append(["r", k, list(store.get(k, []))])
+            events.append(("ok", p, res))
+    ops = [op(index=i, time=i, type=t, process=p, f="txn", value=m)
+           for i, (t, p, m) in enumerate(events)]
+    return History(ops, assign_indices=False)
+
+
+def bank_history(n_txns: int, n_procs: int = 5, n_accounts: int = 8,
+                 initial: int = 10, max_transfer: int = 5,
+                 read_p: float = 0.5, seed: int = 0) -> History:
+    """A valid concurrent bank history: transfers apply atomically to
+    true balances at completion, reads snapshot them. Total balance is
+    conserved by construction. BASELINE config 4 fodder."""
+    rng = random.Random(seed)
+    balances = {a: initial for a in range(n_accounts)}
+    events: list = []
+    open_t: dict[int, tuple] = {}
+    t_count = 0
+    while t_count < n_txns or open_t:
+        idle = n_procs - len(open_t)
+        if t_count < n_txns and idle and (rng.random() < 0.6
+                                          or not open_t):
+            p = rng.choice([q for q in range(n_procs)
+                            if q not in open_t])
+            if rng.random() < read_p:
+                o = ("read", None)
+            else:
+                frm, to = rng.sample(range(n_accounts), 2)
+                o = ("transfer", {"from": frm, "to": to,
+                                  "amount": rng.randint(1, max_transfer)})
+            events.append(("invoke", p, o[0], o[1]))
+            open_t[p] = o
+            t_count += 1
+        else:
+            p = rng.choice(list(open_t))
+            f, v = open_t.pop(p)
+            if f == "transfer":
+                amt = v["amount"]
+                if balances[v["from"]] >= amt:
+                    balances[v["from"]] -= amt
+                    balances[v["to"]] += amt
+                    events.append(("ok", p, f, v))
+                else:
+                    events.append(("fail", p, f, v))
+            else:
+                events.append(("ok", p, f, dict(balances)))
+    ops = [op(index=i, time=i, type=t, process=p, f=f, value=v)
+           for i, (t, p, f, v) in enumerate(events)]
+    return History(ops, assign_indices=False)

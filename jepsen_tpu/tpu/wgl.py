@@ -279,10 +279,11 @@ class PackedBatch:
     history up to 2^21 entries (RangeError beyond)."""
 
     __slots__ = ("inv_t", "ret_t", "trans", "m", "sufmin",
-                 "st0", "M", "S", "B")
+                 "st0", "M", "S", "B", "has_crashed")
 
     def __init__(self, encs: Sequence[Encoded]):
         B = len(encs)
+        self.has_crashed = any(bool(e.crashed.any()) for e in encs)
         M = max((e.m for e in encs), default=0)
         # Bucket to powers of two so the jitted kernel compiles once per
         # bucket rather than once per history length. Generous floors keep
@@ -340,12 +341,13 @@ def _jitted_kernel():
     import jax
 
     return jax.jit(_kernel, static_argnames=("W", "F", "max_iters",
-                                             "reach", "debug"))
+                                             "reach", "debug",
+                                             "crash_free"))
 
 
 def _kernel(inv_t, ret_t, trans, mseg, sufmin, row_seg, st0,
             W: int, F: int, max_iters: int, reach: bool = False,
-            debug: bool = False):
+            debug: bool = False, crash_free: bool = False):
     """The batched WGL frontier search.
 
     Packed data is per-*segment* ([K, M] / [K, M, S]); search rows are
@@ -453,17 +455,26 @@ def _kernel(inv_t, ret_t, trans, mseg, sufmin, row_seg, st0,
                            nmask >> t_ones.astype(jnp.uint32))
         running = (result == RUNNING)[:, None, None]
         ok0 = apply_ok & live[:, :, None] & ~cfg_ovf[:, :, None] & running
-        ok1 = disc_ok & live[:, :, None] & ~cfg_ovf[:, :, None] & running
-        sp = jnp.stack([jnp.where(ok0, s_p, INFi),
-                        jnp.where(ok1, s_p, INFi)], axis=3)
-        sm = jnp.stack([jnp.where(ok0, s_mask, 0),
-                        jnp.where(ok1, s_mask, 0)], axis=3)
-        ss = jnp.stack([jnp.where(ok0, st_nxt, 0),
-                        jnp.where(ok1, st[:, :, None], 0)], axis=3)
-        N = F * W * 2
-        sp = sp.reshape(B, N)
-        sm = sm.reshape(B, N)
-        ss = ss.reshape(B, N)
+        if crash_free:
+            # no crashed entries anywhere in the batch: the discard
+            # action never fires, so successors are half as wide and
+            # the dedup sorts process half the candidates
+            N = F * W
+            sp = jnp.where(ok0, s_p, INFi).reshape(B, N)
+            sm = jnp.where(ok0, s_mask, 0).reshape(B, N)
+            ss = jnp.where(ok0, st_nxt, 0).reshape(B, N)
+        else:
+            ok1 = disc_ok & live[:, :, None] & ~cfg_ovf[:, :, None] & running
+            sp = jnp.stack([jnp.where(ok0, s_p, INFi),
+                            jnp.where(ok1, s_p, INFi)], axis=3)
+            sm = jnp.stack([jnp.where(ok0, s_mask, 0),
+                            jnp.where(ok1, s_mask, 0)], axis=3)
+            ss = jnp.stack([jnp.where(ok0, st_nxt, 0),
+                            jnp.where(ok1, st[:, :, None], 0)], axis=3)
+            N = F * W * 2
+            sp = sp.reshape(B, N)
+            sm = sm.reshape(B, N)
+            ss = ss.reshape(B, N)
 
         # sort + dedup + compact to F slots: two fused multi-key sorts
         # (lax.sort with num_keys compares tuples in ONE pass — far
@@ -550,7 +561,8 @@ def _launch(pb: PackedBatch, rows: Sequence[tuple[int, int]], W: int,
             jnp.asarray(pb.sufmin), jnp.asarray(row_seg),
             jnp.asarray(st0))
     return _jitted_kernel()(*args, W=W, F=F, max_iters=pb.M + 4,
-                            reach=reach)
+                            reach=reach,
+                            crash_free=not pb.has_crashed)
 
 
 def check_batch(encs: Sequence[Encoded], W: int = 32,
@@ -580,20 +592,31 @@ def check_batch_reach(encs: Sequence[Encoded], W: int = 32,
 # Segment-parallel checking of long histories
 # ---------------------------------------------------------------------------
 
-def segment_cuts(enc: Encoded, target_len: int = 2048) -> list[int]:
-    """Cut points for compositional checking. A cut before entry e is
-    sound iff every earlier entry completed before e invoked (zero ops
-    span the cut): real-time order then forces all pre-cut ops before all
-    post-cut ops in ANY linearization, so segments compose through model
-    state alone. Crashed entries (ret=INF) forbid all later cuts, which
-    degrades gracefully to bigger trailing segments."""
+def valid_cut_points(enc: Encoded) -> np.ndarray:
+    """Entry indices where a compositional cut is sound: every earlier
+    entry completed before this entry invoked (zero ops span the cut),
+    so real-time order forces all pre-cut ops before all post-cut ops
+    in ANY linearization. Crashed entries (ret=INF) forbid all later
+    cuts."""
     m = enc.m
     if m == 0:
-        return [0, 0]
+        return np.empty(0, dtype=np.int64)
     prefix_max = np.maximum.accumulate(enc.ret_t)
     valid = np.zeros(m, dtype=bool)
     valid[1:] = prefix_max[:-1] < enc.inv_t[1:]
-    idx = np.flatnonzero(valid)
+    return np.flatnonzero(valid)
+
+
+def segment_cuts(enc: Encoded, target_len: int = 2048,
+                 vcuts: np.ndarray | None = None) -> list[int]:
+    """Cut points for compositional checking (see valid_cut_points);
+    segments come out a little over target_len, degrading gracefully to
+    bigger trailing segments when few cuts exist. Pass vcuts to reuse
+    an already-computed valid_cut_points array."""
+    m = enc.m
+    if m == 0:
+        return [0, 0]
+    idx = valid_cut_points(enc) if vcuts is None else vcuts
     cuts = [0]
     want = target_len
     while want < m:
@@ -608,15 +631,26 @@ def segment_cuts(enc: Encoded, target_len: int = 2048) -> list[int]:
 
 
 def check_segmented(enc: Encoded, target_len: int = 2048, W: int = 32,
-                    F: int = 32, witness: bool = False) -> dict | None:
+                    F: int = 64, witness: bool = False,
+                    prefix_screen: int = 96) -> dict | None:
     """Checks one long history by cutting it into segments, computing
     per-(segment, start-state) final-state reachability in ONE batched
     device launch, and composing reachability masks across segments.
     Returns None when the history doesn't segment usefully (caller uses
-    the plain kernel)."""
+    the plain kernel).
+
+    prefix_screen: before launching, each (segment, start-state) row is
+    screened by a cheap host search over the segment's first
+    ~prefix_screen entries ENDING AT A VALID CUT — a time-complete
+    sub-history, so reach(prefix) == 0 soundly proves reach(segment)
+    == 0 (an arbitrary entry-prefix would NOT be: a pending read may
+    observe a later write). Wrong start states die in the prefix, so
+    the device launch runs ~half the rows and tiny segments resolve
+    exactly on host with no device row at all."""
     if enc.n_states > 32:
         return None
-    cuts = segment_cuts(enc, target_len)
+    vcuts = valid_cut_points(enc)
+    cuts = segment_cuts(enc, target_len, vcuts=vcuts)
     K = len(cuts) - 1
     if K < 2:
         return None
@@ -624,23 +658,57 @@ def check_segmented(enc: Encoded, target_len: int = 2048, W: int = 32,
         return None  # a segment alone exceeds the kernel range
     S = enc.n_states
     segs = [enc.segment(cuts[k], cuts[k + 1]) for k in range(K)]
-    # One packed copy per segment; S search rows share it via the
-    # kernel's row->segment indirection.
-    pb = PackedBatch(segs)
-    rows = [(k, s) for k in range(K) for s in range(S)]
-    out, unk = _launch(pb, rows, W, F, reach=True)
-    out = np.asarray(out)[:len(rows)]
-    unk = np.asarray(unk)[:len(rows)]
+    # resolved mask per (segment, start-state); None = device said
+    # UNKNOWN, resolve lazily on host ONLY if the composition actually
+    # reaches that state (unknown rows are the hardest searches).
+    resolved: dict[tuple[int, int], int | None] = {}
+    rows: list[tuple[int, int]] = []
+    if prefix_screen:
+        for k in range(K):
+            lo, hi = cuts[k], cuts[k + 1]
+            j = np.searchsorted(vcuts, lo + prefix_screen)
+            pre_end = int(vcuts[j]) if (j < len(vcuts)
+                                        and vcuts[j] < hi) else hi
+            if ((pre_end == hi and hi - lo > 2 * prefix_screen)
+                    or enc.crashed[lo:pre_end].any()):
+                # Big segment with no interior cut, or crashed entries
+                # in the would-be prefix: the exhaustive host search
+                # can branch exponentially there (crashes both forbid
+                # cuts and double the frontier per entry) — leave every
+                # state to the kernel instead of screening.
+                rows.extend((k, s) for s in range(S))
+                continue
+            exact = pre_end == hi
+            pre = segs[k] if exact else enc.segment(lo, pre_end)
+            for s in range(S):
+                mask = search_host_reach(pre.with_init(s))
+                if exact:
+                    resolved[(k, s)] = mask
+                elif mask == 0:
+                    resolved[(k, s)] = 0
+                else:
+                    rows.append((k, s))
+    else:
+        rows = [(k, s) for k in range(K) for s in range(S)]
+    if rows:
+        # One packed copy per segment; rows share it via the kernel's
+        # row->segment indirection.
+        pb = PackedBatch(segs)
+        out, unk = _launch(pb, rows, W, F, reach=True)
+        out = np.asarray(out)[:len(rows)]
+        unk = np.asarray(unk)[:len(rows)]
+        for i, (k, s) in enumerate(rows):
+            resolved[(k, s)] = None if unk[i] else int(out[i])
     reach = 1 << enc.init_state
     for k in range(K):
         nreach = 0
         for s in range(S):
-            if not (reach >> s) & 1:
-                continue
-            i = k * S + s
-            mask = (search_host_reach(segs[k].with_init(s)) if unk[i]
-                    else int(out[i]))
-            nreach |= mask
+            if (reach >> s) & 1:
+                mask = resolved[(k, s)]
+                if mask is None:
+                    mask = search_host_reach(segs[k].with_init(s))
+                    resolved[(k, s)] = mask
+                nreach |= mask
         if nreach == 0:
             res: dict = {"valid?": False, "failed-segment": k,
                          "segment-range": [cuts[k], cuts[k + 1]]}
@@ -692,7 +760,7 @@ def analysis(model, hist, algorithm: str = "tpu", W: int = 32,
     # Long histories: segment-parallel path (one batched launch over
     # segments x start-states instead of m sequential frontier steps).
     if enc.m >= 4096:
-        seg = check_segmented(enc, W=W, F=max(F // 2, 32), witness=True)
+        seg = check_segmented(enc, W=W, F=F, witness=True)
         if seg is not None:
             seg["analyzer"] = "tpu-segmented"
             return seg

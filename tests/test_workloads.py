@@ -164,3 +164,74 @@ class TestWorkloadsEndToEnd:
         assert set(workloads.REGISTRY) == {
             "bank", "counter", "long-fork", "queue", "register", "set",
             "set-full", "append", "wr", "unique-ids"}
+
+
+class TestBankCheckFast:
+    def _hist(self, rows, f="read"):
+        from jepsen_tpu.history import History, op
+        evs = []
+        for i, r in enumerate(rows):
+            evs.append(op(type="invoke", process=0, f=f, value=None))
+            evs.append(op(type="ok", process=0, f=f, value=r))
+        return History(evs)
+
+    def test_fold_path_valid_and_anomalies(self):
+        from jepsen_tpu.workloads import bank
+        h = self._hist([{0: 5, 1: 5}, {0: 4, 1: 6}])
+        assert bank.check_fast(h, 10)["valid?"] is True
+        bad = self._hist([{0: 5, 1: 5}, {0: 4, 1: 4}])
+        res = bank.check_fast(bad, 10)
+        assert res["valid?"] is False
+        assert res["first-error"]["type"] == "wrong-total"
+        neg = self._hist([{0: -2, 1: 12}])
+        res = bank.check_fast(neg, 10)
+        assert res["valid?"] is False
+        assert res["first-error"]["type"] == "negative-value"
+        assert bank.check_fast(neg, 10, negative_ok=True)["valid?"] is True
+
+    def test_matrix_path_matches_fold(self):
+        from jepsen_tpu.workloads import bank
+        import random
+        rng = random.Random(5)
+        n_acc = 16  # wide: takes the matrix path
+        rows = []
+        for _ in range(50):
+            vals = [10] * n_acc
+            for _ in range(8):
+                a, b = rng.sample(range(n_acc), 2)
+                amt = rng.randint(1, 5)
+                vals[a] -= amt
+                vals[b] += amt
+            rows.append(dict(enumerate(vals)))
+        h = self._hist(rows)
+        res = bank.check_fast(h, n_acc * 10, device=False)
+        assert res["valid?"] is False  # negatives occur
+        assert bank.check_fast(h, n_acc * 10, negative_ok=True,
+                               device=False)["valid?"] is True
+
+    def test_empty_is_unknown(self):
+        from jepsen_tpu.workloads import bank
+        from jepsen_tpu.history import History
+        assert bank.check_fast(History([]), 10)["valid?"] == "unknown"
+
+
+class TestSynthGenerators:
+    def test_list_append_history_valid(self):
+        from jepsen_tpu.tpu import elle, synth
+        h = synth.list_append_history(800, seed=5)
+        for engine in ("host", "device"):
+            res = elle.check_list_append(h, {"engine": engine})
+            assert res["valid?"] is True, (engine, res["anomaly-types"])
+
+    def test_bank_history_valid(self):
+        from jepsen_tpu.tpu import synth
+        from jepsen_tpu.workloads import bank
+        h = synth.bank_history(800, seed=5)
+        assert bank.check_fast(h, 80)["valid?"] is True
+
+    def test_register_history_with_crashes_valid(self):
+        from jepsen_tpu.checker import models
+        from jepsen_tpu.tpu import synth, wgl
+        h = synth.register_history(150, n_procs=4, seed=9, crash_p=0.15)
+        a = wgl.analysis(models.cas_register(), h, algorithm="wgl")
+        assert a["valid?"] is True, a
