@@ -7,6 +7,7 @@ jepsen/src/jepsen/tests/bank.clj:178-191).
 
 from . import bank  # noqa: F401
 from . import counter  # noqa: F401
+from . import kafka  # noqa: F401
 from . import long_fork  # noqa: F401
 from . import queue  # noqa: F401
 from . import register  # noqa: F401
@@ -18,6 +19,7 @@ from . import unique_ids  # noqa: F401
 REGISTRY = {
     "bank": bank.workload,
     "counter": counter.workload,
+    "kafka": kafka.workload,
     "long-fork": long_fork.workload,
     "queue": queue.workload,
     "register": register.workload,

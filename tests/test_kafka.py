@@ -1,0 +1,246 @@
+"""Kafka queue workload tests: one hand-written history per anomaly
+class the reference's analysis detects
+(jepsen/src/jepsen/tests/kafka.clj:1881-2087), plus the allowed-error
+policy and an end-to-end run against an in-memory log."""
+
+from jepsen_tpu.history import History, op
+from jepsen_tpu.workloads import kafka
+
+
+def K(*events):
+    """history from (type, process, f, value) tuples."""
+    return History([op(type=t, process=p, f=f, value=v)
+                    for t, p, f, v in events])
+
+
+def send_ok(p, k, off, val):
+    return (("invoke", p, "send", [["send", k, val]]),
+            ("ok", p, "send", [["send", k, [off, val]]]))
+
+
+def poll_ok(p, reads):
+    """reads: {k: [[off, val], ...]}"""
+    return (("invoke", p, "poll", [["poll"]]),
+            ("ok", p, "poll", [["poll", reads]]))
+
+
+def flat(*pairs):
+    evs = []
+    for pr in pairs:
+        evs.extend(pr)
+    return K(*evs)
+
+
+class TestValid:
+    def test_clean_send_poll(self):
+        h = flat(send_ok(0, 0, 0, 1), send_ok(0, 0, 1, 2),
+                 poll_ok(1, {0: [[0, 1], [1, 2]]}))
+        res = kafka.check(h)
+        assert res["valid?"] is True, res
+
+    def test_offset_gaps_are_fine(self):
+        # txn metadata takes offset slots; contiguity is rank-based
+        h = flat(send_ok(0, 0, 0, 1), send_ok(0, 0, 5, 2),
+                 poll_ok(1, {0: [[0, 1], [5, 2]]}))
+        res = kafka.check(h)
+        assert res["valid?"] is True, res
+
+
+class TestAnomalies:
+    def test_inconsistent_offsets(self):
+        # two observations disagree about the value at offset 0
+        h = flat(send_ok(0, 0, 0, 1), send_ok(1, 0, 0, 2),
+                 poll_ok(2, {0: [[0, 1]]}))
+        res = kafka.check(h)
+        assert res["valid?"] is False
+        assert "inconsistent-offsets" in res["bad-error-types"], res
+
+    def test_g1a_aborted_read(self):
+        h = K(("invoke", 0, "send", [["send", 0, 9]]),
+              ("fail", 0, "send", [["send", 0, 9]]),
+              *poll_ok(1, {0: [[0, 9]]}))
+        res = kafka.check(h)
+        assert res["valid?"] is False
+        assert "G1a" in res["bad-error-types"], res
+
+    def test_lost_write(self):
+        # v=1 acked at offset 0, never polled; poll sees offset 1
+        h = flat(send_ok(0, 0, 0, 1), send_ok(0, 0, 1, 2),
+                 poll_ok(1, {0: [[1, 2]]}))
+        res = kafka.check(h)
+        assert res["valid?"] is False
+        assert "lost-write" in res["bad-error-types"], res
+
+    def test_unseen_is_informational(self):
+        # acked above the highest polled offset: unseen, not lost
+        h = flat(send_ok(0, 0, 0, 1), send_ok(0, 0, 1, 2),
+                 poll_ok(1, {0: [[0, 1]]}))
+        res = kafka.check(h)
+        assert res["valid?"] is True, res
+        assert res["unseen"] == {0: 1}
+
+    def test_duplicate_offsets(self):
+        # same value observed at two offsets
+        h = flat(send_ok(0, 0, 0, 7),
+                 poll_ok(1, {0: [[0, 7], [3, 7]]}))
+        res = kafka.check(h)
+        assert res["valid?"] is False
+        assert "duplicate-offsets" in res["bad-error-types"], res
+
+    def test_duplicate_writes(self):
+        h = flat(send_ok(0, 0, 0, 7), send_ok(1, 0, 3, 7))
+        res = kafka.check(h)
+        assert res["valid?"] is False
+        assert "duplicate" in res["bad-error-types"], res
+
+    def test_int_poll_skip(self):
+        h = flat(send_ok(0, 0, 0, 1), send_ok(0, 0, 1, 2),
+                 send_ok(0, 0, 2, 3),
+                 (("invoke", 1, "txn", [["poll"], ["poll"]]),
+                  ("ok", 1, "txn", [["poll", {0: [[0, 1]]}],
+                                    ["poll", {0: [[2, 3]]}]])))
+        res = kafka.check(h)
+        assert res["valid?"] is False
+        assert "int-poll-skip" in res["bad-error-types"], res
+
+    def test_int_nonmonotonic_poll(self):
+        h = flat(send_ok(0, 0, 0, 1), send_ok(0, 0, 1, 2),
+                 (("invoke", 1, "txn", [["poll"], ["poll"]]),
+                  ("ok", 1, "txn", [["poll", {0: [[1, 2]]}],
+                                    ["poll", {0: [[0, 1]]}]])))
+        res = kafka.check(h)
+        assert res["valid?"] is False
+        assert "int-nonmonotonic-poll" in res["bad-error-types"], res
+
+    def test_external_nonmonotonic_poll_assign_mode(self):
+        # without subscribe in sub-via, external poll regressions count
+        h = flat(send_ok(0, 0, 0, 1), send_ok(0, 0, 1, 2),
+                 poll_ok(1, {0: [[1, 2]]}),
+                 poll_ok(1, {0: [[0, 1]]}))
+        res = kafka.check(h, {"sub-via": ("assign",)})
+        assert res["valid?"] is False
+        assert "nonmonotonic-poll" in res["bad-error-types"], res
+        # with subscribe, rebalances make this expected
+        res = kafka.check(h, {"sub-via": ("subscribe",)})
+        assert res["valid?"] is True, res
+
+    def test_poll_skip_reset_by_subscribe(self):
+        h = flat(send_ok(0, 0, 0, 1), send_ok(0, 0, 1, 2),
+                 send_ok(0, 0, 2, 3),
+                 poll_ok(1, {0: [[0, 1]]}),
+                 ((("invoke", 1, "subscribe", [0]),
+                   ("ok", 1, "subscribe", [0]))),
+                 poll_ok(1, {0: [[2, 3]]}))
+        res = kafka.check(h, {"sub-via": ("assign",)})
+        # subscribe resets the consumer's expected position
+        assert "poll-skip" not in res["error-types"], res
+
+    def test_nonmonotonic_send(self):
+        h = flat(send_ok(0, 0, 5, 1), send_ok(0, 0, 2, 2),
+                 poll_ok(1, {0: [[2, 2], [5, 1]]}))
+        res = kafka.check(h)
+        assert res["valid?"] is False
+        assert "nonmonotonic-send" in res["bad-error-types"], res
+
+    def test_int_send_skip_allowed(self):
+        # txn writes interleave in kafka's model: always allowed
+        h = flat((("invoke", 0, "send",
+                   [["send", 0, 1], ["send", 0, 2]]),
+                  ("ok", 0, "send",
+                   [["send", 0, [0, 1]], ["send", 0, [4, 2]]])),
+                 send_ok(1, 0, 2, 9),
+                 poll_ok(2, {0: [[0, 1], [2, 9], [4, 2]]}))
+        res = kafka.check(h)
+        assert "int-send-skip" in res["error-types"]
+        assert "int-send-skip" not in res["bad-error-types"]
+
+    def test_wr_cycle_without_ww_deps(self):
+        # T1 reads T2's write and vice versa: G1c, bad when ww-deps off
+        h = K(("invoke", 0, "txn", [["send", 0, 1], ["poll"]]),
+              ("invoke", 1, "txn", [["send", 1, 2], ["poll"]]),
+              ("ok", 0, "txn", [["send", 0, [0, 1]],
+                                ["poll", {1: [[0, 2]]}]]),
+              ("ok", 1, "txn", [["send", 1, [0, 2]],
+                                ["poll", {0: [[0, 1]]}]]))
+        res = kafka.check(h, {"ww-deps": False})
+        assert res["valid?"] is False
+        assert any(t.startswith("G1c") for t in res["bad-error-types"]), res
+        # with ww-deps, G1c is expected (no write isolation)
+        res = kafka.check(h, {"ww-deps": True})
+        assert res["valid?"] is True, res
+
+
+class TestEndToEnd:
+    def test_generated_run_against_memory_log(self):
+        """Drive the generator against an in-memory kafka-like log and
+        check the result is clean."""
+        import random
+
+        rng = random.Random(3)
+        gen_fn = kafka.generator(n_keys=3, seed=3)
+        logs: dict = {}
+        positions: dict = {}  # (proc, k) -> next index
+        events = []
+        for i in range(400):
+            p = i % 4
+            o = gen_fn()
+            f, v = o["f"], o["value"]
+            events.append(("invoke", p, f, v))
+            if f in ("subscribe", "assign"):
+                for k in v:
+                    positions[(p, k)] = 0
+                events.append(("ok", p, f, v))
+                continue
+            done = []
+            for m in v:
+                if m[0] == "send":
+                    _, k, val = m
+                    logs.setdefault(k, []).append(val)
+                    done.append(["send", k, [len(logs[k]) - 1, val]])
+                else:
+                    reads: dict = {}
+                    for k in list(logs):
+                        pos = positions.get((p, k), 0)
+                        log = logs.get(k, [])
+                        if pos < len(log):
+                            n = rng.randint(1, len(log) - pos)
+                            reads[k] = [[pos + j, log[pos + j]]
+                                        for j in range(n)]
+                            positions[(p, k)] = pos + n
+                    done.append(["poll", reads])
+            events.append(("ok", p, f, done))
+        h = K(*events)
+        res = kafka.check(h, {"sub-via": ("assign",)})
+        assert res["valid?"] is True, (res["bad-error-types"],
+                                       res["errors"])
+
+    def test_workload_bundle(self):
+        w = kafka.workload({"ops": 10, "seed": 1})
+        assert "generator" in w and "checker" in w
+
+
+class TestReviewRegressions:
+    def test_info_send_offsets_count(self):
+        """An indeterminate send that still reports its offset must
+        feed the version order (round-3 review finding)."""
+        h = K(("invoke", 0, "send", [["send", 0, 5]]),
+              ("info", 0, "send", [["send", 0, [0, 5]]]),
+              *send_ok(1, 0, 0, 9),
+              *poll_ok(2, {0: [[0, 9]]}))
+        res = kafka.check(h)
+        assert res["valid?"] is False
+        assert "inconsistent-offsets" in res["bad-error-types"], res
+
+    def test_failed_subscribe_does_not_reset_tracking(self):
+        h = flat(send_ok(0, 0, 0, 1), send_ok(0, 0, 1, 2),
+                 send_ok(0, 0, 2, 3),
+                 poll_ok(1, {0: [[0, 1]]}),
+                 (("invoke", 1, "subscribe", [0]),
+                  ("fail", 1, "subscribe", [0])),
+                 poll_ok(1, {0: [[2, 3]]}))
+        res = kafka.check(h, {"sub-via": ("assign",)})
+        assert "poll-skip" in res["bad-error-types"], res
+
+    def test_registry_has_kafka(self):
+        from jepsen_tpu import workloads
+        assert workloads.REGISTRY["kafka"] is kafka.workload

@@ -162,8 +162,8 @@ class TestWorkloadsEndToEnd:
 
     def test_registry_complete(self):
         assert set(workloads.REGISTRY) == {
-            "bank", "counter", "long-fork", "queue", "register", "set",
-            "set-full", "append", "wr", "unique-ids"}
+            "bank", "counter", "kafka", "long-fork", "queue", "register",
+            "set", "set-full", "append", "wr", "unique-ids"}
 
 
 class TestBankCheckFast:
