@@ -119,7 +119,10 @@ def db_generators(opts: dict) -> dict:
 
 
 def db_package(opts: dict) -> dict:
-    """Kill/pause package (combined.clj:148-163)."""
+    """Kill/pause package (combined.clj:148-163). With no db (e.g. a
+    membership-only nemesis) there is nothing to kill: noop."""
+    if opts.get("db") is None:
+        return dict(NOOP_PACKAGE)
     needed = bool({"kill", "pause"} & set(opts["faults"]))
     gens = db_generators(opts)
     generator = gens["generator"]
@@ -421,12 +424,19 @@ DEFAULT_FAULTS = ["partition", "packet", "kill", "pause", "clock",
 
 def nemesis_packages(opts: dict) -> list:
     """The standard package list for an option map
-    (combined.clj:512-522)."""
+    (combined.clj:512-522); membership joins when its fault is
+    requested (nemesis/membership.clj package)."""
+    from . import membership
+
     opts = dict(opts)
     opts["faults"] = set(opts.get("faults", DEFAULT_FAULTS))
-    return [partition_package(opts), packet_package(opts),
+    pkgs = [partition_package(opts), packet_package(opts),
             file_corruption_package(opts), clock_package(opts),
             db_package(opts)]
+    mp = membership.package(opts)
+    if mp is not None:
+        pkgs.append(mp)
+    return pkgs
 
 
 def nemesis_package(opts: dict) -> dict:

@@ -26,6 +26,7 @@ from .. import cli, client as jclient, control, db as jdb, independent
 from .. import generator as gen
 from .. import nemesis as jnemesis
 from .. import testing, workloads
+from ..nemesis import membership
 from ..checker import models
 from ..control import util as cu
 from ..os_setup import debian
@@ -69,23 +70,31 @@ class EtcdDB(jdb.DB):
     def __init__(self, version: str = VERSION):
         self.version = version
 
+    def _daemon_args(self, test, node, cluster_state: str,
+                     cluster: str | None = None):
+        """One flag list for every start path; restarts say
+        'existing' (a fresh 'new' after kill was a bootstrap bug the
+        round-2 advisor flagged), and membership joins pass the
+        current cluster string."""
+        return (
+            {"logfile": LOGFILE, "pidfile": PIDFILE, "chdir": DIR},
+            BINARY,
+            "--log-outputs", "stderr",
+            "--name", str(node),
+            "--listen-peer-urls", peer_url(node),
+            "--listen-client-urls", f"http://0.0.0.0:{CLIENT_PORT}",
+            "--advertise-client-urls", client_url(node),
+            "--initial-cluster-state", cluster_state,
+            "--initial-advertise-peer-urls", peer_url(node),
+            "--initial-cluster", cluster or initial_cluster(test))
+
     def setup(self, test, node):
         logger.info("%s installing etcd %s", node, self.version)
         with control.su():
             url = (f"https://storage.googleapis.com/etcd/{self.version}"
                    f"/etcd-{self.version}-linux-amd64.tar.gz")
             cu.install_archive(url, DIR)
-            cu.start_daemon(
-                {"logfile": LOGFILE, "pidfile": PIDFILE, "chdir": DIR},
-                BINARY,
-                "--log-outputs", "stderr",
-                "--name", str(node),
-                "--listen-peer-urls", peer_url(node),
-                "--listen-client-urls", f"http://0.0.0.0:{CLIENT_PORT}",
-                "--advertise-client-urls", client_url(node),
-                "--initial-cluster-state", "new",
-                "--initial-advertise-peer-urls", peer_url(node),
-                "--initial-cluster", initial_cluster(test))
+            cu.start_daemon(*self._daemon_args(test, node, "new"))
         cu.await_tcp_port(CLIENT_PORT, timeout_secs=60)
 
     def teardown(self, test, node):
@@ -100,22 +109,14 @@ class EtcdDB(jdb.DB):
         return "killed"
 
     def start(self, test, node):
-        self.setup_daemon_only(test, node)
+        self.setup_daemon_only(test, node, cluster_state="existing")
         return "started"
 
-    def setup_daemon_only(self, test, node):
+    def setup_daemon_only(self, test, node, cluster_state: str = "new",
+                          cluster: str | None = None):
         with control.su():
-            cu.start_daemon(
-                {"logfile": LOGFILE, "pidfile": PIDFILE, "chdir": DIR},
-                BINARY,
-                "--log-outputs", "stderr",
-                "--name", str(node),
-                "--listen-peer-urls", peer_url(node),
-                "--listen-client-urls", f"http://0.0.0.0:{CLIENT_PORT}",
-                "--advertise-client-urls", client_url(node),
-                "--initial-cluster-state", "new",
-                "--initial-advertise-peer-urls", peer_url(node),
-                "--initial-cluster", initial_cluster(test))
+            cu.start_daemon(*self._daemon_args(test, node,
+                                               cluster_state, cluster))
 
     def pause(self, test, node):
         with control.su():
@@ -188,6 +189,32 @@ class EtcdHttp:
                                         "value": _b64(new)}}]})
         return bool(out.get("succeeded"))
 
+    def txn_rw(self, guards, puts) -> bool:
+        """One atomic kv/txn: every (key, mod_revision) guard must
+        still hold, then all (key, value) puts apply. Missing keys
+        guard with revision 0."""
+        out = self.post("/v3/kv/txn", {
+            "compare": [{"key": _b64(k), "target": "MOD",
+                         "mod_revision": str(rev or 0),
+                         "result": "EQUAL"} for k, rev in guards],
+            "success": [{"requestPut": {"key": _b64(k),
+                                        "value": _b64(v)}}
+                        for k, v in puts]})
+        return bool(out.get("succeeded"))
+
+    # -- cluster membership (v3/cluster gateway) --------------------------
+
+    def members(self) -> list[dict]:
+        out = self.post("/v3/cluster/member/list", {})
+        return out.get("members") or []
+
+    def member_add(self, peer: str) -> dict:
+        return self.post("/v3/cluster/member/add", {"peerURLs": [peer]})
+
+    def member_remove(self, member_id) -> dict:
+        return self.post("/v3/cluster/member/remove",
+                         {"ID": member_id})
+
 
 def _definite(e: Exception) -> bool:
     """True when the request certainly never executed (safe to :fail);
@@ -235,9 +262,12 @@ class EtcdRegisterClient(jclient.Client):
 
 
 class EtcdAppendClient(jclient.Client):
-    """Elle list-append transactions: each [f k v] micro-op reads or
-    appends to a JSON list under /append/<k>, appends via
-    mod-revision-guarded txns retried a few times."""
+    """Elle list-append transactions, executed ATOMICALLY: snapshot
+    reads of every touched key, then one kv/txn guarded on all their
+    mod_revisions applying every append — so the recorded txn really is
+    one serializable unit and the checker can't flag healthy etcd for
+    interleavings between micro-ops (round-2 advisor finding). Guard
+    conflicts retry with a fresh snapshot."""
 
     def __init__(self, http_factory=EtcdHttp, retries: int = 8):
         self.http_factory = http_factory
@@ -249,35 +279,183 @@ class EtcdAppendClient(jclient.Client):
         c.http = self.http_factory(node)
         return c
 
-    def _append(self, key: str, v) -> None:
-        for _ in range(self.retries):
-            cur, _rev = self.http.get(key)
-            if cur is None:
-                if self.http.cas_create(key, json.dumps([v])):
-                    return
-                continue
-            lst = json.loads(cur)
-            if self.http.cas(key, cur, json.dumps(lst + [v])):
-                return
-        raise RuntimeError(f"append contention on {key}")
+    def _attempt(self, mops):
+        keys = {k for _f, k, _v in mops}
+        snap = {k: self.http.get(f"/append/{k}") for k in keys}
+        lists = {k: (json.loads(v) if v else [])
+                 for k, (v, _r) in snap.items()}
+        seen_empty = {k for k, (v, _r) in snap.items() if v is None}
+        out = []
+        dirty = set()
+        for f, k, v in mops:
+            if f == "r":
+                cur = lists[k]
+                out.append(["r", k,
+                            None if (k in seen_empty and k not in dirty
+                                     and not cur) else list(cur)])
+            else:
+                lists[k].append(v)
+                dirty.add(k)
+                out.append(["append", k, v])
+        guards = [(f"/append/{k}", snap[k][1]) for k in sorted(keys)]
+        puts = [(f"/append/{k}", json.dumps(lists[k]))
+                for k in sorted(dirty)]
+        if not puts and len(keys) <= 1:
+            return out  # a single-key read is atomic by itself
+        if self.http.txn_rw(guards, puts):
+            return out
+        return None
 
     def invoke(self, test, op):
         try:
-            out = []
-            for f, k, v in op.value:
-                key = f"/append/{k}"
-                if f == "r":
-                    cur, _ = self.http.get(key)
-                    out.append(
-                        ["r", k, json.loads(cur) if cur else None])
-                else:
-                    self._append(key, v)
-                    out.append(["append", k, v])
-            return op.copy(type="ok", value=out)
+            for _ in range(self.retries):
+                out = self._attempt(op.value)
+                if out is not None:
+                    return op.copy(type="ok", value=out)
+            # every attempt's guard failed BEFORE any put applied:
+            # provably nothing committed, so this is a definite :fail
+            return op.copy(type="fail",
+                           error="txn contention exhausted retries")
         except Exception as e:  # noqa: BLE001
             if _definite(e):
                 return op.copy(type="fail", error=repr(e))
             return op.copy(type="info", error=repr(e))
+
+
+# ---------------------------------------------------------------------------
+# Membership
+# ---------------------------------------------------------------------------
+
+class EtcdMembership(membership.MembershipState):
+    """Join/remove etcd members through the v3 cluster gateway
+    (exercises nemesis/membership.clj's state-machine shape against a
+    real member API). Views are frozensets of member names; a name->id
+    map is kept for removals."""
+
+    def __init__(self, http_factory=EtcdHttp, db: EtcdDB | None = None,
+                 seed=None):
+        super().__init__()
+        self.http_factory = http_factory
+        self.db = db
+        self.member_ids: dict = {}
+        self.rng = random.Random(seed)
+
+    def node_view(self, test, node):
+        try:
+            members = self.http_factory(node).members()
+        except Exception:  # noqa: BLE001 — node down: view unknown
+            return None
+        names = set()
+        for m in members:
+            name = m.get("name") or f"id:{m.get('ID')}"
+            names.add(name)
+            if m.get("ID") is not None:
+                self.member_ids[name] = m["ID"]
+        return frozenset(names)
+
+    def merge_views(self, test):
+        """Majority view wins; ties go to the largest view (prefer
+        believing a node exists over not)."""
+        views = list(self.node_views.values())
+        if not views:
+            return None
+        counts: dict = {}
+        for v in views:
+            counts[v] = counts.get(v, 0) + 1
+        return max(counts, key=lambda v: (counts[v], len(v)))
+
+    def fs(self):
+        return {"add-member", "remove-member"}
+
+    def op(self, test):
+        from .. import generator as gen
+
+        if self.view is None or self.pending:
+            return gen.PENDING
+        nodes = set(map(str, test.get("nodes", ())))
+        active = set(self.view) & nodes
+        removed = nodes - set(self.view)
+        # shrink while strictly above the majority floor, then grow
+        # back — never create a quorum-less (useless) cluster state
+        # (membership.clj principle 1). Random targets so churn
+        # covers every node over a run, not one fixed victim.
+        if active and len(active) > (len(nodes) // 2) + 1:
+            return {"type": "info", "f": "remove-member",
+                    "value": self.rng.choice(sorted(active))}
+        if removed:
+            return {"type": "info", "f": "add-member",
+                    "value": self.rng.choice(sorted(removed))}
+        return gen.PENDING
+
+    def _any_http(self, test, exclude=None):
+        for n_ in test.get("nodes", ()):
+            if str(n_) != exclude and str(n_) in (self.view or ()):
+                return self.http_factory(n_)
+        return self.http_factory(test["nodes"][0])
+
+    def invoke(self, test, op):
+        target = op.value
+        try:
+            if op.f == "remove-member":
+                mid = self.member_ids.get(target)
+                if mid is None:
+                    return op.copy(value=[target, "unknown-member"])
+                self._any_http(test, exclude=target).member_remove(mid)
+                return op.copy(value=[target, "removed"])
+            if op.f == "add-member":
+                self._any_http(test).member_add(peer_url(target))
+                if self.db is not None:
+                    cluster = ",".join(
+                        f"{m}={peer_url(m)}"
+                        for m in sorted(set(self.view) | {target}))
+                    with control.with_session(test, target):
+                        with control.su():
+                            # a removed member's stale data dir makes
+                            # etcd restart with its old (permanently
+                            # removed) identity and get rejected by
+                            # peers; rejoin must start clean
+                            control.exec_("rm", "-rf",
+                                          f"{DIR}/{target}.etcd")
+                        self.db.setup_daemon_only(
+                            test, target, cluster_state="existing",
+                            cluster=cluster)
+                return op.copy(value=[target, "added"])
+            raise ValueError(f"unknown membership f {op.f!r}")
+        except Exception as e:  # noqa: BLE001
+            return op.copy(value=[target, f"error: {e!r}"])
+
+    def resolve_op(self, test, pair):
+        _inv, done = pair
+        d = dict(done)
+        f, val = d.get("f"), d.get("value")
+        if not isinstance(val, tuple) or len(val) != 2:
+            return True  # malformed/errored: nothing to wait for
+        target, status = val
+        if isinstance(status, str) and status.startswith("error"):
+            return True
+        if self.view is None:
+            return False
+        if f == "remove-member":
+            return target not in self.view
+        if f == "add-member":
+            return target in self.view
+        return True
+
+
+def membership_package(opts: dict) -> dict | None:
+    """An etcd membership package for nemesis composition. Without an
+    explicit membership db, the test's db is used so re-added members
+    actually get their daemon started (a voting member added via the
+    API but never started would hold the nemesis pending forever and
+    put quorum one failure away)."""
+    o = dict(opts)
+    mopts = dict(o.get("membership") or {})
+    mopts.setdefault("state", EtcdMembership(
+        http_factory=mopts.pop("http_factory", EtcdHttp),
+        db=mopts.pop("db", o.get("db")),
+        seed=mopts.pop("seed", None)))
+    o["membership"] = mopts
+    return membership.package(o)
 
 
 # ---------------------------------------------------------------------------

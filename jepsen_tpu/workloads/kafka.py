@@ -7,8 +7,11 @@ reference's largest workload, 2149 LoC) — operation encoding
 (kafka.clj:24-97), version orders from send/poll offset agreement
 (docstring §2, inconsistent-offsets), aborted reads (§1, G1a), lost
 writes below the highest observed offset (§3, lost-write), unseen
-messages, ww/wr/rw dependency cycles via elle (§4), internal read/write
-contiguity (poll/send skip + nonmonotonic, §5-6), duplicates, and the
+messages, ww/wr dependency cycles via elle (§4), internal poll/send
+contiguity, external poll contiguity, and nonmonotonic sends (§5-6;
+external send-SKIPS are deliberately not detected, matching the
+reference — "We don't even bother looking at external send skips",
+kafka.clj:2022), duplicates, and the
 allowed-error-type policy (kafka.clj:2019-2046: int-send-skip and G0
 always allowed; poll-skip/nonmonotonic-poll allowed under subscribe;
 G1c allowed when ww edges are inferred).

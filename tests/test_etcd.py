@@ -90,11 +90,19 @@ def test_db_teardown_kill_pause(test_map):
 # ---------------------------------------------------------------------------
 
 class FakeEtcd:
-    """Shared in-memory etcd v3 KV semantics (linearizable)."""
+    """Shared in-memory etcd v3 KV semantics (linearizable), with real
+    mod revisions so guarded txns behave like the gateway."""
 
     def __init__(self):
         self.lock = threading.Lock()
         self.kv: dict = {}
+        self.rev: dict = {}
+        self.next_rev = 0
+
+    def _write(self, key, value):
+        self.next_rev += 1
+        self.kv[key] = value
+        self.rev[key] = self.next_rev
 
     def factory(self, node):
         return FakeHttp(self)
@@ -108,25 +116,34 @@ class FakeHttp:
         with self.state.lock:
             if key not in self.state.kv:
                 return None, None
-            return self.state.kv[key], 1
+            return self.state.kv[key], self.state.rev[key]
 
     def put(self, key, value):
         with self.state.lock:
-            self.state.kv[key] = value
+            self.state._write(key, value)
 
     def cas(self, key, old, new):
         with self.state.lock:
             if self.state.kv.get(key) == old:
-                self.state.kv[key] = new
+                self.state._write(key, new)
                 return True
             return False
 
     def cas_create(self, key, new):
         with self.state.lock:
             if key not in self.state.kv:
-                self.state.kv[key] = new
+                self.state._write(key, new)
                 return True
             return False
+
+    def txn_rw(self, guards, puts):
+        with self.state.lock:
+            for k, rev in guards:
+                if (self.state.rev.get(k) or 0) != (rev or 0):
+                    return False
+            for k, v in puts:
+                self.state._write(k, v)
+            return True
 
 
 def test_register_client_ops():

@@ -1,0 +1,238 @@
+"""Membership nemesis tests: the join/remove state machine against a
+fake etcd member API (mirrors nemesis/membership.clj:109-247 +
+membership/state.clj), including view polling, pending-op resolution,
+and generator legality."""
+
+import threading
+import time
+
+from jepsen_tpu import generator as gen
+from jepsen_tpu import testing
+from jepsen_tpu.generator.context import Context
+from jepsen_tpu.nemesis import membership
+from jepsen_tpu.suites import etcd
+
+
+class FakeCluster:
+    """Shared in-memory member list keyed by name."""
+
+    def __init__(self, nodes):
+        self.lock = threading.Lock()
+        self.members = {str(n): {"name": str(n), "ID": 1000 + i}
+                        for i, n in enumerate(nodes)}
+        self.next_id = 2000
+
+    def factory(self, node):
+        return FakeMemberHttp(self, str(node))
+
+
+class FakeMemberHttp:
+    def __init__(self, cluster: FakeCluster, node: str):
+        self.cluster = cluster
+        self.node = node
+
+    def members(self):
+        with self.cluster.lock:
+            if self.node not in self.cluster.members:
+                raise ConnectionRefusedError(f"{self.node} not serving")
+            return [dict(m) for m in self.cluster.members.values()]
+
+    def member_add(self, peer: str):
+        name = peer.split("//")[1].split(":")[0]
+        with self.cluster.lock:
+            self.cluster.members[name] = {"name": name,
+                                          "ID": self.cluster.next_id}
+            self.cluster.next_id += 1
+        return {"member": dict(self.cluster.members[name])}
+
+    def member_remove(self, member_id):
+        with self.cluster.lock:
+            for name, m in list(self.cluster.members.items()):
+                if m["ID"] == member_id:
+                    del self.cluster.members[name]
+                    return {}
+        raise RuntimeError(f"no member {member_id}")
+
+
+NODES = ["n1", "n2", "n3", "n4", "n5"]
+
+
+def make_test():
+    t = testing.noop_test()
+    t.update(nodes=list(NODES))
+    return t
+
+
+def make_nemesis(cluster):
+    state = etcd.EtcdMembership(http_factory=cluster.factory)
+    return membership.MembershipNemesis(state, interval=0.02), state
+
+
+def await_(pred, timeout=3.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(0.01)
+    return False
+
+
+class TestStateMachine:
+    def test_view_converges_from_polling(self):
+        cluster = FakeCluster(NODES)
+        nem, state = make_nemesis(cluster)
+        test = make_test()
+        nem.setup(test)
+        try:
+            assert await_(lambda: state.view == frozenset(NODES))
+        finally:
+            nem.teardown(test)
+
+    def test_remove_then_add_cycle(self):
+        cluster = FakeCluster(NODES)
+        nem, state = make_nemesis(cluster)
+        test = make_test()
+        nem.setup(test)
+        try:
+            assert await_(lambda: state.view is not None)
+            g = membership.MembershipGenerator(nem)
+            ctx = Context.for_test({"concurrency": 2})
+
+            def next_op():
+                res = g.op(test, ctx)
+                while res[0] is gen.PENDING:
+                    time.sleep(0.02)
+                    res = g.op(test, ctx)
+                return res[0]
+
+            # policy: shrink to the quorum floor, then grow back
+            op1 = next_op()
+            assert op1.f == "remove-member"
+            done = nem.invoke(test, op1)
+            assert done.value[1] == "removed"
+            assert done.value[0] not in cluster.members
+            # pending until the pollers see the new view
+            assert await_(lambda: not state.pending)
+            op2 = next_op()
+            assert op2.f == "remove-member"
+            nem.invoke(test, op2)
+            assert await_(lambda: not state.pending)
+            assert len(cluster.members) == 3
+            # at the floor: the only legal op is adding one back
+            op3 = next_op()
+            assert op3.f == "add-member"
+            done3 = nem.invoke(test, op3)
+            assert done3.value[1] == "added"
+            assert await_(lambda: not state.pending)
+            assert len(cluster.members) == 4
+        finally:
+            nem.teardown(test)
+
+    def test_never_removes_below_quorum(self):
+        cluster = FakeCluster(NODES)
+        nem, state = make_nemesis(cluster)
+        test = make_test()
+        nem.setup(test)
+        try:
+            assert await_(lambda: state.view is not None)
+            removed = 0
+            for _ in range(6):
+                o = None
+
+                def ready():
+                    nonlocal o
+                    with nem.lock:
+                        o = state.op(test)
+                    return o is not gen.PENDING
+                if not await_(ready, timeout=1.0):
+                    break
+                if o["f"] != "remove-member":
+                    break
+                nem.invoke(test, gen.fill_in_op(
+                    dict(o), Context.for_test({"concurrency": 2})))
+                removed += 1
+                await_(lambda: not state.pending)
+            # 5 nodes: majority quorum floor is 3 -> at most 2 removals
+            assert removed == 2, removed
+            assert len(cluster.members) == 3
+        finally:
+            nem.teardown(test)
+
+    def test_down_node_view_ignored(self):
+        cluster = FakeCluster(NODES)
+        state = etcd.EtcdMembership(http_factory=cluster.factory)
+        test = make_test()
+        # n9 isn't a member: its view poll raises and must be ignored
+        assert state.node_view(test, "n9") is None
+
+    def test_fs(self):
+        cluster = FakeCluster(NODES)
+        _nem, state = make_nemesis(cluster)
+        assert state.fs() == {"add-member", "remove-member"}
+
+
+class TestPackage:
+    def test_package_gated_on_fault(self):
+        assert membership.package({"faults": set()}) is None
+        cluster = FakeCluster(NODES)
+        pkg = etcd.membership_package({
+            "faults": {"membership"},
+            "membership": {"http_factory": cluster.factory,
+                           "view-interval": 0.02}})
+        assert pkg is not None
+        assert isinstance(pkg["nemesis"], membership.MembershipNemesis)
+        assert pkg["generator"] is not None
+
+    def test_combined_packages_include_membership(self):
+        from jepsen_tpu.nemesis import combined
+
+        cluster = FakeCluster(NODES)
+        state = etcd.EtcdMembership(http_factory=cluster.factory)
+        pkgs = combined.nemesis_packages({
+            "db": None, "faults": {"membership"},
+            "membership": {"state": state}})
+        assert any(isinstance(p.get("nemesis"),
+                              membership.MembershipNemesis)
+                   for p in pkgs if p)
+
+
+class TestReviewRegressions:
+    def test_missing_state_raises_helpful_error(self):
+        import pytest
+        with pytest.raises(ValueError, match="MembershipState"):
+            membership.package({"faults": {"membership"}})
+
+    def test_add_member_wipes_stale_data_dir(self):
+        """Rejoining with a stale data dir restarts the old removed
+        identity; the add path must clean it (round-3 review)."""
+        from jepsen_tpu.control.core import Action
+        from jepsen_tpu.control.dummy import DummyRemote
+
+        cluster = FakeCluster(NODES)
+        db = etcd.EtcdDB()
+        state = etcd.EtcdMembership(http_factory=cluster.factory, db=db)
+        state.view = frozenset(NODES[:4])
+        state.member_ids = {n: 1000 + i for i, n in enumerate(NODES)}
+        remote = DummyRemote()
+        test = make_test()
+        test["remote"] = remote
+        test["sessions"] = {n: remote.connect({"host": n})
+                            for n in NODES}
+        from jepsen_tpu.history import op as mkop
+        done = state.invoke(test, mkop(type="info", f="add-member",
+                                       value="n5"))
+        assert done.value == ["n5", "added"]
+        got = [a.cmd for a in test["sessions"]["n5"].log
+               if isinstance(a, Action)]
+        joined = " ; ".join(got)
+        assert "rm -rf /opt/etcd/n5.etcd" in joined, got
+        assert "--initial-cluster-state existing" in joined, got
+        assert "n5=http://n5:2380" in joined, got
+
+    def test_package_uses_test_db_by_default(self):
+        cluster = FakeCluster(NODES)
+        db = etcd.EtcdDB()
+        pkg = etcd.membership_package({
+            "faults": {"membership"}, "db": db,
+            "membership": {"http_factory": cluster.factory}})
+        assert pkg["state"].db is db
