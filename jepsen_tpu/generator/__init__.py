@@ -40,14 +40,25 @@ logger = logging.getLogger(__name__)
 # Public sentinel: "I might have an op later, but not yet."
 PENDING = "pending"
 
-# Module RNG so schedules are reproducible under a seed.
+# Module fallback RNG, used when the context carries no "rng". Tests
+# that set test["seed"] get a per-test RNG installed by
+# Context.for_test(test), so two concurrent seeded tests in one
+# process can't perturb each other's schedules; seedless tests share
+# this fallback, which set_seed controls (the simulator relies on it).
 _rng = _random.Random()
 
 
 def set_seed(seed) -> None:
-    """Seeds the generator-scheduling RNG (mix choice, stagger jitter,
-    soonest-op tie-breaks) for deterministic schedules."""
+    """Seeds the fallback generator-scheduling RNG (mix choice, stagger
+    jitter, soonest-op tie-breaks). Setting test["seed"] instead scopes
+    determinism to that one test's context."""
     _rng.seed(seed)
+
+
+def _ctx_rng(ctx):
+    """The context's per-test RNG, else the module fallback."""
+    r = ctx.get("rng") if ctx is not None else None
+    return r if r is not None else _rng
 
 
 # ---------------------------------------------------------------------------
@@ -635,10 +646,11 @@ def nemesis(nemesis_gen, client_gen=None):
 # soonest-op-map + any
 # ---------------------------------------------------------------------------
 
-def soonest_op_map(m1, m2):
+def soonest_op_map(m1, m2, rng=None):
     """Of two {'op','gen','weight',...} maps, the one whose op occurs
     sooner; ties broken randomly proportional to weight
     (generator.clj:894-938)."""
+    rng = rng or _rng
     if m1 is None:
         return m2
     if m2 is None:
@@ -652,7 +664,7 @@ def soonest_op_map(m1, m2):
     if t1 == t2:
         w1 = m1.get("weight", 1)
         w2 = m2.get("weight", 1)
-        chosen = m1 if _rng.randrange(w1 + w2) < w1 else m2
+        chosen = m1 if rng.randrange(w1 + w2) < w1 else m2
         chosen = dict(chosen)
         chosen["weight"] = w1 + w2
         return chosen
@@ -674,7 +686,8 @@ class Any(Generator):
             res = op(g, test, ctx)
             if res is not None:
                 soonest = soonest_op_map(
-                    soonest, {"op": res[0], "gen": res[1], "i": i})
+                    soonest, {"op": res[0], "gen": res[1], "i": i},
+                    rng=_ctx_rng(ctx))
         if soonest is None:
             return None
         gens = list(self.gens)
@@ -723,7 +736,8 @@ class EachThread(Generator):
             res = op(g, test, tctx)
             if res is not None:
                 soonest = soonest_op_map(
-                    soonest, {"op": res[0], "gen": res[1], "thread": thread})
+                    soonest, {"op": res[0], "gen": res[1],
+                              "thread": thread}, rng=_ctx_rng(ctx))
         if soonest is not None:
             gens = dict(self.gens)
             gens[soonest["thread"]] = soonest["gen"]
@@ -771,14 +785,15 @@ class Reserve(Generator):
             if res is not None:
                 soonest = soonest_op_map(
                     soonest, {"op": res[0], "gen": res[1],
-                              "weight": len(threads), "i": i})
+                              "weight": len(threads), "i": i},
+                    rng=_ctx_rng(ctx))
         dctx = self.ctx_filters[-1](ctx)
         res = op(self.gens[-1], test, dctx)
         if res is not None:
             soonest = soonest_op_map(
                 soonest, {"op": res[0], "gen": res[1],
                           "weight": dctx.all_thread_count(),
-                          "i": len(self.ranges)})
+                          "i": len(self.ranges)}, rng=_ctx_rng(ctx))
         if soonest is None:
             return None
         gens = list(self.gens)
@@ -829,15 +844,18 @@ class Mix(Generator):
         self.gens = gens
 
     def op(self, test, ctx):
+        rng = _ctx_rng(ctx)
         i, gens = self.i, self.gens
+        if i is None:
+            i = rng.randrange(len(gens)) if gens else 0
         while gens:
             res = op(gens[i], test, ctx)
             if res is not None:
                 new_gens = list(gens)
                 new_gens[i] = res[1]
-                return res[0], Mix(_rng.randrange(len(new_gens)), new_gens)
+                return res[0], Mix(rng.randrange(len(new_gens)), new_gens)
             gens = gens[:i] + gens[i + 1:]
-            i = _rng.randrange(len(gens)) if gens else 0
+            i = rng.randrange(len(gens)) if gens else 0
         return None
 
     def update(self, test, ctx, event):
@@ -848,7 +866,7 @@ def mix(gens):
     gens = list(gens)
     if not gens:
         return None
-    return Mix(_rng.randrange(len(gens)), gens)
+    return Mix(None, gens)  # first index drawn from the ctx RNG
 
 
 class Limit(Generator):
@@ -1036,12 +1054,13 @@ class Stagger(Generator):
         o, g2 = res
         if o is PENDING:
             return o, self
+        rng = _ctx_rng(ctx)
         next_time = self.next_time if self.next_time is not None else ctx.time
         if next_time <= o.time:
-            return o, Stagger(self.dt, o.time + int(_rng.random() * self.dt),
+            return o, Stagger(self.dt, o.time + int(rng.random() * self.dt),
                               g2)
         return (o.copy(time=next_time),
-                Stagger(self.dt, next_time + int(_rng.random() * self.dt),
+                Stagger(self.dt, next_time + int(rng.random() * self.dt),
                         g2))
 
     def update(self, test, ctx, event):

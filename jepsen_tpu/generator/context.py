@@ -116,7 +116,12 @@ class Context:
             free_threads=all_bits,
             thread_index_to_process=tuple(names),
             process_to_thread={name: name for name in names},
-            ext={},
+            # per-test scheduling RNG, only when the test asks for one:
+            # two seeded tests in one process keep independent
+            # deterministic schedules, while seedless tests keep using
+            # the module fallback (which set_seed controls)
+            ext=({"rng": random.Random(test["seed"])}
+                 if test.get("seed") is not None else {}),
         )
 
     def _clone(self, **kw) -> "Context":

@@ -5,8 +5,9 @@ and no threads, producing the history the generator *would* create.
 
 Capability reference: jepsen/src/jepsen/generator/test.clj (simulate
 test.clj:35-112, quick/perfect/perfect-info/imperfect 115-187). The
-reference rebinds rand-int around a seeded stream; here we seed the
-generator module's own RNG.
+reference rebinds rand-int around a seeded stream; here simulate seeds
+the generator module's fallback RNG (its contexts carry no per-test
+RNG, so every scheduling draw goes through that fallback).
 """
 
 from __future__ import annotations
