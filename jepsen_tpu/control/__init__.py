@@ -58,8 +58,12 @@ def remote_for(test: dict) -> Remote:
 
 
 def _default_ssh() -> Remote:
+    # ssh wrapped for auto-reconnect + retry of transport failures,
+    # like the reference's default sshj-in-retry stack
+    # (control.clj with-remote + control/retry.clj)
+    from .retry import RetryingRemote
     from .ssh import SshRemote
-    return SshRemote()
+    return RetryingRemote(SshRemote())
 
 
 def session(test: dict, node) -> Session:

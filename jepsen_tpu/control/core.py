@@ -26,6 +26,14 @@ class RemoteError(Exception):
             f"out={out!r}, err={err!r})")
 
 
+class TransportError(RemoteError):
+    """The transport itself failed (connection refused/dropped, ssh
+    exit 255, timeout) — the command may never have run. Safe to retry
+    at the remote layer (the reference's ::ssh-failed class,
+    control/retry.clj:1-14); a command's own non-zero exit is NOT a
+    TransportError."""
+
+
 @dataclass
 class Action:
     """A command to run remotely: argv string, optional stdin, sudo user,

@@ -1,0 +1,73 @@
+"""Auto-reconnecting, retrying wrapper around any Remote.
+
+Capability reference: jepsen/src/jepsen/control/retry.clj:35-72 — SSH
+client stacks fail spuriously; their commands can almost always be
+retried. The wrapper keeps the underlying session in a reconnect
+wrapper (jepsen_tpu.reconnect) and retries TRANSPORT failures (the
+analog of the reference's ::ssh-failed — never a command's own
+non-zero exit, which comes back as a Result) with jittered backoff,
+cycling the session between attempts.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+
+from .. import reconnect
+from .core import Action, Remote, Result, Session, TransportError
+
+RETRIES = 5
+BACKOFF_S = 0.1
+
+
+class RetryingSession(Session):
+    def __init__(self, remote: Remote, conn_spec: dict):
+        self.conn_spec = conn_spec
+        self.wrapper = reconnect.Wrapper(
+            open=lambda: remote.connect(conn_spec),
+            close=lambda s: s.disconnect(),
+            name=("control", conn_spec.get("host")))
+        self.wrapper.open()
+
+    def _with_retry(self, f):
+        tries = RETRIES
+        while True:
+            try:
+                # cycle the session ONLY on transport failures: a
+                # command's own error (nonzero exit, missing file on
+                # scp) must not tear down the shared ControlMaster and
+                # kill other threads' in-flight multiplexed commands
+                with self.wrapper.with_conn(
+                        cycle_on=TransportError) as sess:
+                    return f(sess)
+            except TransportError:
+                if tries <= 0:
+                    raise
+                tries -= 1
+                time.sleep(BACKOFF_S / 2 + random.random() * BACKOFF_S)
+
+    def execute(self, action: Action) -> Result:
+        return self._with_retry(lambda s: s.execute(action))
+
+    def upload(self, local_paths, remote_path) -> None:
+        return self._with_retry(
+            lambda s: s.upload(local_paths, remote_path))
+
+    def download(self, remote_paths, local_path) -> None:
+        return self._with_retry(
+            lambda s: s.download(remote_paths, local_path))
+
+    def disconnect(self) -> None:
+        self.wrapper.close()
+
+
+class RetryingRemote(Remote):
+    """Wraps another Remote so transport failures reconnect + retry
+    (retry.clj `remote`, 67-72)."""
+
+    def __init__(self, remote: Remote):
+        self.remote = remote
+
+    def connect(self, conn_spec: dict) -> RetryingSession:
+        return RetryingSession(self.remote, conn_spec)

@@ -10,12 +10,27 @@ without bundling a crypto stack.
 from __future__ import annotations
 
 import os
+import shutil
 import subprocess
 import tempfile
 import threading
 from typing import Optional
 
-from .core import Action, Remote, RemoteError, Result, Session, wrap_sudo
+from .core import (Action, Remote, RemoteError, Result, Session,
+                   TransportError, wrap_sudo)
+
+
+_SSH_FAILURE_MARKERS = (
+    "ssh:", "connection closed", "connection refused",
+    "connection reset", "connection timed out", "broken pipe",
+    "lost connection", "kex_exchange", "permission denied",
+    "host key verification", "no route to host", "operation timed out",
+    "mux_client", "control socket")
+
+
+def _looks_like_ssh_failure(stderr: str) -> bool:
+    s = (stderr or "").lower()
+    return any(m in s for m in _SSH_FAILURE_MARKERS)
 
 
 class SshSession(Session):
@@ -50,10 +65,28 @@ class SshSession(Session):
     def execute(self, action: Action) -> Result:
         cmd = wrap_sudo(action)
         argv = ["ssh", *self._base_args(), self._dest(), cmd]
-        with self._sem:
-            proc = subprocess.run(
-                argv, input=action.stdin, capture_output=True, text=True,
-                timeout=action.timeout)
+        try:
+            with self._sem:
+                proc = subprocess.run(
+                    argv, input=action.stdin, capture_output=True,
+                    text=True, timeout=action.timeout)
+        except subprocess.TimeoutExpired as e:
+            # NOT a TransportError: the command started and may still
+            # be running remotely — retrying would double-execute it
+            raise RemoteError("ssh command timed out", cmd=cmd,
+                              node=self.host) from e
+        except OSError as e:  # spawn failure (e.g. no ssh binary)
+            raise TransportError(f"ssh spawn failed: {e}", cmd=cmd,
+                                 node=self.host) from e
+        if proc.returncode == 255 and _looks_like_ssh_failure(
+                proc.stderr):
+            # 255 with a client-side error message is ssh's own failure
+            # (connect/auth/channel): retryable. A remote command that
+            # itself exits 255 without such a message passes through as
+            # a Result, preserving exec_result's no-raise contract.
+            raise TransportError("ssh transport failed", exit=255,
+                                 out=proc.stdout, err=proc.stderr,
+                                 cmd=cmd, node=self.host)
         return Result(exit=proc.returncode, out=proc.stdout,
                       err=proc.stderr, cmd=cmd)
 
@@ -85,8 +118,21 @@ class SshSession(Session):
         return args + [*map(str, srcs), dst]
 
     def _run_scp(self, argv) -> None:
-        with self._sem:
-            proc = subprocess.run(argv, capture_output=True, text=True)
+        try:
+            with self._sem:
+                proc = subprocess.run(argv, capture_output=True,
+                                      text=True)
+        except OSError as e:
+            raise TransportError(f"scp spawn failed: {e}",
+                                 cmd=" ".join(argv),
+                                 node=self.host) from e
+        if proc.returncode == 255 or (
+                proc.returncode != 0
+                and _looks_like_ssh_failure(proc.stderr)):
+            raise TransportError("scp transport failed",
+                                 exit=proc.returncode, out=proc.stdout,
+                                 err=proc.stderr, cmd=" ".join(argv),
+                                 node=self.host)
         if proc.returncode != 0:
             raise RemoteError("scp failed", exit=proc.returncode,
                               out=proc.stdout, err=proc.stderr,
@@ -99,6 +145,7 @@ class SshSession(Session):
                            capture_output=True, timeout=10)
         except Exception:  # noqa: BLE001
             pass
+        shutil.rmtree(self._ctl_dir, ignore_errors=True)
 
 
 class SshRemote(Remote):
