@@ -21,8 +21,19 @@ from .core import (Action, Remote, RemoteError, Result, Session,
 
 
 def _default_runner(argv, stdin=None, timeout=600.0) -> Result:
-    proc = subprocess.run(argv, input=stdin, capture_output=True,
-                          text=True, timeout=timeout)
+    from .core import TransportError
+
+    try:
+        proc = subprocess.run(argv, input=stdin, capture_output=True,
+                              text=True, timeout=timeout)
+    except subprocess.TimeoutExpired as e:
+        # same contract as the ssh remote: a timed-out command may
+        # still be running — RemoteError, never silently retried
+        raise RemoteError(f"{argv[0]} command timed out",
+                          cmd=" ".join(argv)) from e
+    except OSError as e:  # spawn failure (e.g. no docker/kubectl)
+        raise TransportError(f"{argv[0]} spawn failed: {e}",
+                             cmd=" ".join(argv)) from e
     return Result(exit=proc.returncode, out=proc.stdout,
                   err=proc.stderr, cmd=" ".join(argv))
 
@@ -36,7 +47,10 @@ def resolve_container_id(host, runner: Callable = _default_runner) -> str:
         _addr, port = host.rsplit(":", 1)
         ps = runner(["docker", "ps"]).out
         for line in ps.splitlines()[1:]:
-            if re.search(rf"[:>]{re.escape(port)}(->|/|\s|,)", line):
+            # PUBLISHED port only (":PORT->"); matching the container-
+            # internal side ("->PORT/") would resolve every node to
+            # the first container sharing a service port
+            if re.search(rf":{re.escape(port)}->", line):
                 return line.split()[0]
         raise RemoteError(f"no container publishes port {port}",
                           node=host, cmd="docker ps")

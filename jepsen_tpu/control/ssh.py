@@ -117,18 +117,23 @@ class SshSession(Session):
             args += ["-i", self.key]
         return args + [*map(str, srcs), dst]
 
-    def _run_scp(self, argv) -> None:
+    def _run_scp(self, argv, timeout: float = 600.0) -> None:
         try:
             with self._sem:
                 proc = subprocess.run(argv, capture_output=True,
-                                      text=True)
+                                      text=True, timeout=timeout)
+        except subprocess.TimeoutExpired as e:
+            raise RemoteError("scp timed out", cmd=" ".join(argv),
+                              node=self.host) from e
         except OSError as e:
             raise TransportError(f"scp spawn failed: {e}",
                                  cmd=" ".join(argv),
                                  node=self.host) from e
-        if proc.returncode == 255 or (
-                proc.returncode != 0
-                and _looks_like_ssh_failure(proc.stderr)):
+        # Only exit 255 is the ssh client's own failure; marker
+        # matching on other exits would misread remote-file errors
+        # ("scp: /x: Permission denied", exit 1) as transport trouble
+        # and pointlessly retry-cycle the shared ControlMaster.
+        if proc.returncode == 255:
             raise TransportError("scp transport failed",
                                  exit=proc.returncode, out=proc.stdout,
                                  err=proc.stderr, cmd=" ".join(argv),

@@ -322,22 +322,41 @@ def clock_package(opts: dict) -> dict:
 
 
 class FileCorruptionNemesis(n.Nemesis):
-    """bitflip/truncate on spec-selected nodes (combined.clj:364-399).
-    Ops: {'f': 'bitflip'|'truncate',
+    """bitflip/truncate on spec-selected nodes (combined.clj:364-399),
+    plus lazyfs lose-unfsynced-writes when a lazyfs map is supplied
+    (jepsen/src/jepsen/lazyfs.clj:246-295).
+    Ops: {'f': 'bitflip'|'truncate'|'lose-unfsynced-writes',
           'value': [node-spec, corruption-map]}."""
 
-    def __init__(self, db, bf=None, trunc=None):
+    def __init__(self, db, bf=None, trunc=None, lazyfs_map=None):
         self.db = db
         self.bf = bf or n.bitflip()
         self.trunc = trunc or n.truncate_file()
+        if lazyfs_map is not None:
+            from .. import lazyfs as lazyfs_mod
+
+            # accept a bare dir or partial map like every other
+            # lazyfs entry point
+            lazyfs_map = lazyfs_mod.lazyfs(lazyfs_map)
+        self.lazyfs_map = lazyfs_map
 
     def setup(self, test):
         return FileCorruptionNemesis(self.db, self.bf.setup(test),
-                                     self.trunc.setup(test))
+                                     self.trunc.setup(test),
+                                     self.lazyfs_map)
 
     def invoke(self, test, op):
         spec, corruption = op.value
         targets = db_nodes(test, self.db, spec) or []
+        if op.f == "lose-unfsynced-writes":
+            from .. import control, lazyfs
+
+            got = control.on_nodes(
+                test,
+                lambda t, node: lazyfs.lose_unfsynced_writes(
+                    self.lazyfs_map),
+                targets)
+            return op.copy(value=got)
         plan = {node: corruption for node in targets}
         op2 = op.copy(value=plan)
         if op.f == "bitflip":
@@ -351,24 +370,38 @@ class FileCorruptionNemesis(n.Nemesis):
         self.trunc.teardown(test)
 
     def fs(self):
-        return {"bitflip", "truncate"}
+        fs = {"bitflip", "truncate"}
+        if self.lazyfs_map is not None:
+            fs.add("lose-unfsynced-writes")
+        return fs
 
 
 def file_corruption_package(opts: dict) -> dict:
     """File corruption package (combined.clj:401-460).
-    opts['file_corruption']: {'targets': [spec...], 'corruptions':
+    opts['file_corruption']: {'targets': [spec...], 'lazyfs': map?,
+    'corruptions':
     [{'type': 'bitflip', 'file': ..., 'probability': p-or-dist},
-     {'type': 'truncate', 'file': ..., 'drop': n-or-dist}]}."""
+     {'type': 'truncate', 'file': ..., 'drop': n-or-dist},
+     {'type': 'lose-unfsynced-writes'}  # needs 'lazyfs'
+    ]}."""
     faults = opts["faults"]
     needed = "file-corruption" in faults
     fc = opts.get("file_corruption") or {}
     db = opts["db"]
     targets = fc.get("targets", node_specs(db))
     corruptions = fc.get("corruptions") or []
+    lazyfs_map = fc.get("lazyfs")
+    if lazyfs_map is None and any(
+            c["type"] == "lose-unfsynced-writes" for c in corruptions):
+        raise ValueError("lose-unfsynced-writes corruption needs "
+                         "file_corruption['lazyfs'] (a lazyfs map)")
 
     def g_fn(test, ctx):
         target = random.choice(targets)
         c = random.choice(corruptions)
+        if c["type"] == "lose-unfsynced-writes":
+            return {"type": "info", "f": "lose-unfsynced-writes",
+                    "value": [target, None]}
         corruption = {"file": c["file"]}
         if c["type"] == "bitflip":
             p = c.get("probability")
@@ -385,11 +418,12 @@ def file_corruption_package(opts: dict) -> dict:
 
     g = (gen.stagger(opts.get("interval", DEFAULT_INTERVAL), g_fn)
          if corruptions else None)
+    nem = FileCorruptionNemesis(db, lazyfs_map=lazyfs_map)
     return {
         "generator": g if needed else None,
         "final_generator": None,
-        "nemesis": FileCorruptionNemesis(db),
-        "perf": {("file-corruption", frozenset({"bitflip", "truncate"}),
+        "nemesis": nem,
+        "perf": {("file-corruption", frozenset(nem.fs()),
                   frozenset(), "#99F2E2")},
     }
 

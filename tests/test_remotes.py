@@ -257,3 +257,12 @@ class TestRetryRegressions:
                                        "Connection closed by remote host")
         assert not _looks_like_ssh_failure("myapp: fatal error 42")
         assert not _looks_like_ssh_failure("")
+
+
+class TestDockerRegressions:
+    def test_internal_port_not_matched(self):
+        """localhost:2379 (the container-INTERNAL port) must not
+        resolve to the first container (round-3 review finding)."""
+        r = ScriptedRunner(lambda argv, stdin: Result(0, DOCKER_PS, "", ""))
+        with pytest.raises(RemoteError):
+            resolve_container_id("localhost:2379", r)
