@@ -25,26 +25,30 @@ THRIFT_DIR = "/opt/thrift"
 
 
 def install_thrift() -> None:
-    """Builds thrift from source (charybdefs.clj:30-43)."""
+    """Builds thrift from source (charybdefs.clj:30-43); needs the
+    build toolchain already installed."""
     cu.install_archive(THRIFT_URL, THRIFT_DIR)
     with control.cd(THRIFT_DIR):
         control.exec_("./configure", "--prefix=/usr")
         control.exec_("make", "-j4")
-        control.exec_("make", "install")
+        with control.su():
+            control.exec_("make", "install")
     with control.cd(f"{THRIFT_DIR}/lib/py"):
-        control.exec_("python", "setup.py", "install")
+        with control.su():
+            control.exec_("python", "setup.py", "install")
 
 
 def install() -> None:
     """Builds charybdefs and mounts FAULTY over REAL
-    (charybdefs.clj:45-65)."""
+    (charybdefs.clj:45-65). Toolchain first: thrift's configure/make
+    need a compiler on a fresh node."""
     if not cu.exists_p(BIN):
-        install_thrift()
         with control.su():
             debian.install(["build-essential", "cmake", "libfuse-dev",
                             "fuse"])
             control.exec_("mkdir", "-p", DIR)
             control.exec_("chmod", "777", DIR)
+        install_thrift()
         control.exec_("git", "clone", "--depth", "1",
                       "https://github.com/scylladb/charybdefs.git", DIR)
         with control.cd(DIR):

@@ -132,32 +132,37 @@ def concurrency_limit(limit: int, inner: Checker) -> Checker:
 # Stats + exceptions
 # ---------------------------------------------------------------------------
 
-def _stats_fold(ops) -> dict:
-    oks = infos = fails = 0
-    for o in ops:
-        if o.type == "ok":
-            oks += 1
-        elif o.type == "info":
-            infos += 1
-        elif o.type == "fail":
-            fails += 1
-    return {"valid?": oks > 0, "count": oks + infos + fails,
-            "ok-count": oks, "fail-count": fails, "info-count": infos}
-
-
 def stats() -> Checker:
     """Success/failure rates, overall and by :f; valid only if every :f has
-    some ok ops (checker.clj:159-200)."""
+    some ok ops (checker.clj:159-200). Single counting pass — no
+    per-f op lists (SURVEY P4: O(n) folds stay O(1) in memory)."""
 
     def run(test, hist, opts):
-        ops = [o for o in hist if o.type != "invoke" and h.is_client_op(o)]
-        all_stats = _stats_fold(ops)
-        by_f: dict = {}
-        for o in ops:
-            by_f.setdefault(o.f, []).append(o)
-        by_f = {f: _stats_fold(l) for f, l in sorted(
-            by_f.items(), key=lambda kv: str(kv[0]))}
-        out = dict(all_stats)
+        by: dict = {}
+        for o in hist:
+            t = o.type
+            if t == "invoke" or not h.is_client_op(o):
+                continue
+            d = by.get(o.f)
+            if d is None:
+                d = by[o.f] = [0, 0, 0]  # ok, info, fail
+            if t == "ok":
+                d[0] += 1
+            elif t == "info":
+                d[1] += 1
+            elif t == "fail":
+                d[2] += 1
+
+        def fold(oks, infos, fails):
+            return {"valid?": oks > 0, "count": oks + infos + fails,
+                    "ok-count": oks, "fail-count": fails,
+                    "info-count": infos}
+
+        by_f = {f: fold(*c) for f, c in sorted(
+            by.items(), key=lambda kv: str(kv[0]))}
+        out = fold(sum(c[0] for c in by.values()),
+                   sum(c[1] for c in by.values()),
+                   sum(c[2] for c in by.values()))
         out["by-f"] = by_f
         out["valid?"] = merge_valid(r["valid?"] for r in by_f.values())
         return out
@@ -357,6 +362,203 @@ def _frequency_distribution(points, values):
     return {p: values[min(n - 1, int(n * p))] for p in points}
 
 
+def _set_full_results_slow(hist) -> tuple[list, dict]:
+    """Object-model per-element lifecycle fold (the correctness
+    reference; O(reads x elements))."""
+    elements: dict = {}
+    dups: dict = {}
+    for op in hist:
+        if not h.is_client_op(op):
+            continue
+        if op.f == "add":
+            if op.type == "invoke":
+                elements[op.value] = _SetFullElement(op.value)
+            elif op.type == "ok" and op.value in elements:
+                elements[op.value].add_ok(op)
+        elif op.f == "read" and op.type == "ok":
+            inv = hist.invocation(op)
+            if inv is None:
+                continue
+            vals = op.value or []
+            for k, n in Counter(vals).items():
+                if n > 1:
+                    dups[k] = max(dups.get(k, 0), n)
+            vset = set(vals)
+            for element, state in elements.items():
+                if element in vset:
+                    state.read_present(inv, op)
+                else:
+                    state.read_absent(inv, op)
+    rs = [e.results() for _k, e in sorted(elements.items(),
+                                          key=lambda kv: str(kv[0]))]
+    return rs, dups
+
+
+def _set_full_results_fast(hist) -> tuple[list, dict] | None:
+    """Array formulation of the same fold (SURVEY P4): per-element
+    last-present/last-absent/known reduce to segment max/min over
+    (element, read) membership pairs, so cost is O(total read volume)
+    in C instead of O(reads x elements) in Python. Returns None when
+    the history isn't int-valued (caller falls back).
+
+    last_absent needs the highest read (in invocation order) NOT
+    containing an element: with reads ranked 0..R-1, that is
+    R-1-k where k is the element's trailing run of consecutive
+    present ranks ending at R-1 (k=0 when absent from the last read).
+    """
+    import numpy as np
+
+    seen_add: set = set()       # elements with an add invocation
+    add_ok: dict = {}           # element -> first add-ok op
+    reads: list = []            # (inv_index, inv_time, comp_index,
+    #                              comp_time, comp_pos, values)
+    for pos, op in enumerate(hist):
+        f = op.f
+        if f == "add":
+            if not h.is_client_op(op):
+                continue
+            if type(op.value) is not int:
+                return None
+            ty = op.type
+            if ty == "invoke":
+                seen_add.add(op.value)
+            elif ty == "ok" and op.value in seen_add:
+                add_ok.setdefault(op.value, op)
+        elif f == "read" and op.type == "ok":
+            if not h.is_client_op(op):
+                continue
+            inv = hist.invocation(op)
+            if inv is None:
+                continue
+            reads.append((inv.index, inv.time or 0, op.index,
+                          op.time or 0, inv, op, op.value or []))
+    elements = sorted(seen_add)  # numeric order for array ops
+    E, R = len(elements), len(reads)
+    elem_arr = np.asarray(elements, dtype=np.int64)
+    reads.sort(key=lambda r: r[0])  # rank = invocation order
+    inv_idx = np.asarray([r[0] for r in reads], dtype=np.int64)
+    inv_time = np.asarray([r[1] for r in reads], dtype=np.int64)
+    inv_ops = [r[4] for r in reads]   # invocation Op per rank
+    comp_ops = [r[5] for r in reads]  # completion Op per rank
+
+    # One vectorized pass per read, in invocation-rank order: updates
+    # last-present ranks, first-present completion (for known), the
+    # trailing consecutive-present run (for last_absent), and
+    # duplicate counts — O(read volume + reads * E), no global sort.
+    BIG = np.iinfo(np.int64).max
+    comp_idx = np.asarray([r[2] for r in reads], dtype=np.int64)
+    comp_time = np.asarray([r[3] for r in reads], dtype=np.int64)
+    last_present = np.full(E, -1, dtype=np.int64)
+    first_pres_comp = np.full(E, BIG, dtype=np.int64)
+    first_pres_comp_time = np.zeros(E, dtype=np.int64)
+    first_pres_rank = np.full(E, -1, dtype=np.int64)
+    run = np.zeros(E, dtype=np.int64)
+    dups: dict = {}
+    for rank in range(R):
+        try:
+            vals = np.asarray(reads[rank][6], dtype=np.int64)
+        except (TypeError, ValueError, OverflowError):
+            return None
+        if vals.size:
+            uniq, counts = np.unique(vals, return_counts=True)
+            for j in np.flatnonzero(counts > 1):
+                k = int(uniq[j])
+                dups[k] = max(dups.get(k, 0), int(counts[j]))
+            # keep only elements that were actually added
+            p = np.searchsorted(elem_arr, uniq)
+            p = np.clip(p, 0, max(E - 1, 0))
+            ok = (elem_arr[p] == uniq) if E else np.zeros(
+                len(uniq), dtype=bool)
+            ids = p[ok]
+        else:
+            ids = np.empty(0, dtype=np.int64)
+        last_present[ids] = rank  # ranks ascend: assignment == max
+        ci, ct = int(comp_idx[rank]), int(comp_time[rank])
+        upd = ids[first_pres_comp[ids] > ci]
+        first_pres_comp[upd] = ci
+        first_pres_comp_time[upd] = ct
+        first_pres_rank[upd] = rank
+        # elements absent from this read restart their run at 0
+        nrun = np.zeros(E, dtype=np.int64)
+        nrun[ids] = run[ids] + 1
+        run = nrun
+    # last_absent = highest rank NOT containing the element: R-1 minus
+    # the trailing consecutive-present run (-1 when no reads at all)
+    last_absent = ((R - 1) - run if R
+                   else np.full(E, -1, dtype=np.int64))
+
+    # known = first confirming event in history order: add-ok or
+    # present-read completion, whichever completes first
+    add_ok_idx = np.full(E, BIG, dtype=np.int64)
+    for i, e in enumerate(elements):
+        o = add_ok.get(e)
+        if o is not None:
+            add_ok_idx[i] = o.index
+    known_idx = np.minimum(add_ok_idx, first_pres_comp)
+    has_known = known_idx < BIG
+
+    lp, la = last_present, last_absent
+    has_p = lp >= 0
+    has_a = la >= 0
+    if R:
+        lp_idx = np.where(has_p, inv_idx[np.clip(lp, 0, None)], -1)
+        la_idx = np.where(has_a, inv_idx[np.clip(la, 0, None)], -1)
+        la_time = np.where(has_a, inv_time[np.clip(la, 0, None)], -1)
+        lp_time = np.where(has_p, inv_time[np.clip(lp, 0, None)], -1)
+    else:  # no successful reads at all: everything is never-read
+        lp_idx = la_idx = np.full(E, -1, dtype=np.int64)
+        la_time = lp_time = np.full(E, -1, dtype=np.int64)
+    stable = has_p & (la < lp)
+    lost = has_known & has_a & (lp < la) & (known_idx < la_idx)
+
+    # times + latencies (checker.clj results, 435-470)
+    add_ok_time = np.zeros(E, dtype=np.int64)
+    for i, e in enumerate(elements):
+        o = add_ok.get(e)
+        if o is not None:
+            add_ok_time[i] = o.time or 0
+    by_add = add_ok_idx <= first_pres_comp
+    known_time = np.where(by_add, add_ok_time, first_pres_comp_time)
+
+    stable_time = np.where(has_a, la_time + 1, 0)
+    lost_time = np.where(has_p, lp_time + 1, 0)
+    stable_lat = np.maximum(0, stable_time - known_time) // 1_000_000
+    lost_lat = np.maximum(0, lost_time - known_time) // 1_000_000
+
+    # rows in str(element) order, matching the object path exactly;
+    # plain-list views keep the row loop free of numpy scalar overhead
+    stable_l = stable.tolist()
+    lost_l = lost.tolist()
+    sl_l = stable_lat.tolist()
+    ll_l = lost_lat.tolist()
+    hk_l = has_known.tolist()
+    ha_l = has_a.tolist()
+    la_l = la.tolist()
+    by_add_l = by_add.tolist()
+    fpr_l = first_pres_rank.tolist()
+    idx_of = {e: i for i, e in enumerate(elements)}
+    rs = []
+    for e in sorted(elements, key=str):
+        i = idx_of[e]
+        outcome = ("stable" if stable_l[i]
+                   else "lost" if lost_l[i] else "never-read")
+        if not hk_l[i]:
+            known = None
+        elif by_add_l[i]:
+            known = add_ok.get(e)
+        else:  # existence proven by a read's completion (slow-path op)
+            known = comp_ops[fpr_l[i]]
+        rs.append({
+            "element": e,
+            "outcome": outcome,
+            "stable-latency": sl_l[i] if stable_l[i] else None,
+            "lost-latency": ll_l[i] if lost_l[i] else None,
+            "known": known,
+            "last-absent": (inv_ops[la_l[i]] if ha_l[i] else None),
+        })
+    return rs, dups
+
+
 def set_full(checker_opts: dict | None = None) -> Checker:
     """Rigorous per-element set analysis: stable/lost/never-read outcomes
     with stable/lost latencies (checker.clj:320-612)."""
@@ -364,32 +566,9 @@ def set_full(checker_opts: dict | None = None) -> Checker:
     copts.update(checker_opts or {})
 
     def run(test, hist, opts):
-        elements: dict = {}
-        dups: dict = {}
-        for op in hist:
-            if not h.is_client_op(op):
-                continue
-            if op.f == "add":
-                if op.type == "invoke":
-                    elements[op.value] = _SetFullElement(op.value)
-                elif op.type == "ok" and op.value in elements:
-                    elements[op.value].add_ok(op)
-            elif op.f == "read" and op.type == "ok":
-                inv = hist.invocation(op)
-                if inv is None:
-                    continue
-                vals = op.value or []
-                for k, n in Counter(vals).items():
-                    if n > 1:
-                        dups[k] = max(dups.get(k, 0), n)
-                vset = set(vals)
-                for element, state in elements.items():
-                    if element in vset:
-                        state.read_present(inv, op)
-                    else:
-                        state.read_absent(inv, op)
-        rs = [e.results() for _k, e in sorted(elements.items(),
-                                              key=lambda kv: str(kv[0]))]
+        fast = _set_full_results_fast(hist)
+        rs, dups = (fast if fast is not None
+                    else _set_full_results_slow(hist))
         outcomes: dict = {}
         for r in rs:
             outcomes.setdefault(r["outcome"], []).append(r)
