@@ -56,47 +56,52 @@ def _unwrap_event(k, event):
 
 class SequentialGenerator(gen.Generator):
     """Works through keys one at a time; every thread works the current
-    key until its generator is exhausted (independent.clj:37-53)."""
+    key until its generator is exhausted (independent.clj:37-53).
+
+    `cur` uses a distinct _FRESH sentinel for "key not started": a
+    generator's continuation can legitimately BE None (an exhausted
+    one-element Seq flattens to its element's continuation), and
+    treating that as "fresh" would restart the key's generator
+    forever."""
+
+    _FRESH = object()
 
     __slots__ = ("keys", "fgen", "i", "cur")
 
-    def __init__(self, keys, fgen, i=0, cur=None):
+    def __init__(self, keys, fgen, i=0, cur=_FRESH):
         self.keys = tuple(keys)
         self.fgen = fgen
         self.i = i
         self.cur = cur
 
-    def _current(self):
-        if self.cur is not None:
-            return self.i, self.cur
-        if self.i < len(self.keys):
-            return self.i, self.fgen(self.keys[self.i])
-        return self.i, None
-
     def op(self, test, ctx):
-        i, cur = self._current()
-        while cur is not None or i < len(self.keys):
-            if cur is None:
+        i, cur = self.i, self.cur
+        while i < len(self.keys) or cur is not SequentialGenerator._FRESH:
+            if cur is SequentialGenerator._FRESH:
                 cur = self.fgen(self.keys[i])
             res = gen.op(cur, test, ctx)
-            if res is not None:
-                o, g = res
-                if o is gen.PENDING:
-                    return gen.PENDING, SequentialGenerator(
-                        self.keys, self.fgen, i, g)
-                return (_wrap_op(self.keys[i], o),
-                        SequentialGenerator(self.keys, self.fgen, i, g))
-            i, cur = i + 1, None
+            if res is None:
+                i, cur = i + 1, SequentialGenerator._FRESH
+                if i >= len(self.keys):
+                    return None
+                continue
+            o, g = res
+            if o is gen.PENDING:
+                return gen.PENDING, SequentialGenerator(
+                    self.keys, self.fgen, i, g)
+            return (_wrap_op(self.keys[i], o),
+                    SequentialGenerator(self.keys, self.fgen, i, g))
         return None
 
     def update(self, test, ctx, event):
-        i, cur = self._current()
-        if cur is None:
+        cur = self.cur
+        if cur is SequentialGenerator._FRESH or cur is None:
             return self
         return SequentialGenerator(
-            self.keys, self.fgen, i,
+            self.keys, self.fgen, self.i,
             gen.update(cur, test, ctx, _unwrap_event(
-                self.keys[i] if i < len(self.keys) else None, event)))
+                self.keys[self.i] if self.i < len(self.keys) else None,
+                event)))
 
 
 def sequential_generator(keys, fgen) -> SequentialGenerator:

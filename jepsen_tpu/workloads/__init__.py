@@ -5,7 +5,10 @@ test families. Each module exposes `workload(opts) -> {"generator": ...,
 jepsen/src/jepsen/tests/bank.clj:178-191).
 """
 
+from . import adya  # noqa: F401
 from . import bank  # noqa: F401
+from . import causal  # noqa: F401
+from . import causal_reverse  # noqa: F401
 from . import counter  # noqa: F401
 from . import kafka  # noqa: F401
 from . import long_fork  # noqa: F401
@@ -17,7 +20,10 @@ from . import txn_wr  # noqa: F401
 from . import unique_ids  # noqa: F401
 
 REGISTRY = {
+    "adya-g2": adya.workload,
     "bank": bank.workload,
+    "causal": causal.workload,
+    "causal-reverse": causal_reverse.workload,
     "counter": counter.workload,
     "kafka": kafka.workload,
     "long-fork": long_fork.workload,

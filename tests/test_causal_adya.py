@@ -1,0 +1,249 @@
+"""Causal, causal-reverse, and adya G2 workload tests: model
+semantics on literal histories plus clusterless end-to-end runs with
+correct and broken in-memory clients (mirror
+jepsen/src/jepsen/tests/causal.clj, causal_reverse.clj, adya.clj)."""
+
+import threading
+
+from jepsen_tpu import client as jclient
+from jepsen_tpu import core, independent, testing
+from jepsen_tpu import generator as gen
+from jepsen_tpu.history import History, op
+from jepsen_tpu.workloads import adya, causal, causal_reverse
+
+
+def H(*events):
+    """(type, f, value, position, link) tuples -> ok-only history."""
+    return History([op(type=t, process=0, f=f, value=v, position=p,
+                       link=lk)
+                    for t, f, v, p, lk in events])
+
+
+class TestCausalModel:
+    def test_valid_causal_order(self):
+        h = H(("ok", "read-init", 0, 1, "init"),
+              ("ok", "write", 1, 2, 1),
+              ("ok", "read", 1, 3, 2),
+              ("ok", "write", 2, 4, 3),
+              ("ok", "read", 2, 5, 4))
+        res = causal.check().check({}, h, {})
+        assert res["valid?"] is True, res
+
+    def test_broken_link(self):
+        h = H(("ok", "read-init", 0, 1, "init"),
+              ("ok", "write", 1, 2, 99))  # links to unseen position
+        res = causal.check().check({}, h, {})
+        assert res["valid?"] is False
+        assert "Cannot link" in res["error"]
+
+    def test_write_skips_counter(self):
+        h = H(("ok", "read-init", 0, 1, "init"),
+              ("ok", "write", 2, 2, 1))  # expected 1
+        res = causal.check().check({}, h, {})
+        assert res["valid?"] is False
+        assert "expected value 1" in res["error"]
+
+    def test_stale_read(self):
+        h = H(("ok", "read-init", 0, 1, "init"),
+              ("ok", "write", 1, 2, 1),
+              ("ok", "read", 0, 3, 2))  # reads old value
+        res = causal.check().check({}, h, {})
+        assert res["valid?"] is False
+
+    def test_read_init_nonzero(self):
+        h = H(("ok", "read-init", 7, 1, "init"),)
+        res = causal.check().check({}, h, {})
+        assert res["valid?"] is False
+        assert "init value" in res["error"]
+
+
+class CausalClient(jclient.Client):
+    """Single-site causal register per key: positions increase, links
+    chain; optionally loses a write (making later reads stale)."""
+
+    def __init__(self, state=None, lose_write=False):
+        self.state = state if state is not None else {
+            "lock": threading.Lock(), "regs": {}, "pos": 0}
+        self.lose_write = lose_write
+
+    def open(self, test, node):
+        return CausalClient(self.state, self.lose_write)
+
+    def invoke(self, test, o):
+        k, v = independent.key_(o.value), independent.value_(o.value)
+        with self.state["lock"]:
+            reg = self.state["regs"].setdefault(
+                k, {"value": 0, "counter": 0, "last": "init"})
+            self.state["pos"] += 1
+            pos = self.state["pos"]
+            link = reg["last"]
+            reg["last"] = pos
+            if o.f == "write":
+                if not (self.lose_write and v == 1):
+                    reg["value"] = v
+                reg["counter"] += 1
+                out = v
+            else:
+                out = reg["value"]
+            return o.copy(type="ok",
+                          value=independent.ktuple(k, out),
+                          position=pos,
+                          link="init" if o.f == "read-init" else link)
+
+
+class TestCausalEndToEnd:
+    def _run(self, client):
+        w = causal.workload({"keys": [0, 1, 2]})
+        test = testing.noop_test()
+        test.update(nodes=["n1"], concurrency=2, client=client,
+                    checker=w["checker"],
+                    generator=gen.clients(w["generator"]))
+        return core.run(test)
+
+    def test_valid(self):
+        t = self._run(CausalClient())
+        assert t["results"]["valid?"] is True, t["results"]
+
+    def test_lost_write_detected(self):
+        t = self._run(CausalClient(lose_write=True))
+        assert t["results"]["valid?"] is False
+
+
+class TestCausalReverse:
+    def W(self, *events):
+        return History([op(type=t, process=p, f=f, value=v)
+                        for t, p, f, v in events])
+
+    def test_valid_order(self):
+        h = self.W(("invoke", 0, "write", 1), ("ok", 0, "write", 1),
+                   ("invoke", 1, "write", 2), ("ok", 1, "write", 2),
+                   ("invoke", 2, "read", None),
+                   ("ok", 2, "read", [1, 2]))
+        res = causal_reverse.checker().check({}, h, {})
+        assert res["valid?"] is True, res
+
+    def test_t2_without_t1(self):
+        # write 1 acked before write 2 invoked; a read sees 2 but not 1
+        h = self.W(("invoke", 0, "write", 1), ("ok", 0, "write", 1),
+                   ("invoke", 1, "write", 2), ("ok", 1, "write", 2),
+                   ("invoke", 2, "read", None),
+                   ("ok", 2, "read", [2]))
+        res = causal_reverse.checker().check({}, h, {})
+        assert res["valid?"] is False
+        assert res["errors"][0]["missing"] == [1]
+
+    def test_concurrent_writes_not_flagged(self):
+        # both writes in flight together: no precedence either way
+        h = self.W(("invoke", 0, "write", 1), ("invoke", 1, "write", 2),
+                   ("ok", 0, "write", 1), ("ok", 1, "write", 2),
+                   ("invoke", 2, "read", None),
+                   ("ok", 2, "read", [2]))
+        res = causal_reverse.checker().check({}, h, {})
+        assert res["valid?"] is True, res
+
+
+class SetPerKeyClient(jclient.Client):
+    """Blind writes into a per-key set; reads return it (optionally
+    hiding an early write from later reads)."""
+
+    def __init__(self, state=None, hide_first=False):
+        self.state = state if state is not None else {
+            "lock": threading.Lock(), "sets": {}}
+        self.hide_first = hide_first
+
+    def open(self, test, node):
+        return SetPerKeyClient(self.state, self.hide_first)
+
+    def invoke(self, test, o):
+        k, v = independent.key_(o.value), independent.value_(o.value)
+        with self.state["lock"]:
+            s = self.state["sets"].setdefault(k, [])
+            if o.f == "write":
+                s.append(v)
+                return o.copy(type="ok")
+            vals = list(s)
+            if self.hide_first and len(vals) > 2:
+                vals = vals[1:]  # drop the oldest acked write
+            return o.copy(type="ok",
+                          value=independent.ktuple(k, vals))
+
+
+class TestCausalReverseEndToEnd:
+    def _run(self, client):
+        w = causal_reverse.workload({"keys": [0, 1],
+                                     "per-key-limit": 40})
+        test = testing.noop_test()
+        test.update(nodes=["n1"], concurrency=4, client=client,
+                    checker=w["checker"],
+                    generator=gen.clients(w["generator"]))
+        return core.run(test)
+
+    def test_valid(self):
+        t = self._run(SetPerKeyClient())
+        assert t["results"]["valid?"] is True, t["results"]
+
+    def test_reordered_visibility_detected(self):
+        t = self._run(SetPerKeyClient(hide_first=True))
+        assert t["results"]["valid?"] is False
+
+
+class G2Client(jclient.Client):
+    """Predicate-read-then-insert client: under the lock at most one
+    insert per key succeeds (serializable); broken mode lets both
+    commit (the G2 anomaly)."""
+
+    def __init__(self, state=None, broken=False):
+        self.state = state if state is not None else {
+            "lock": threading.Lock(), "rows": {}}
+        self.broken = broken
+
+    def open(self, test, node):
+        return G2Client(self.state, self.broken)
+
+    def invoke(self, test, o):
+        k = independent.key_(o.value)
+        with self.state["lock"]:
+            existing = self.state["rows"].get(k)
+            if existing and not self.broken:
+                return o.copy(type="fail")
+            self.state["rows"].setdefault(k, []).append(
+                independent.value_(o.value))
+            return o.copy(type="ok")
+
+
+class TestAdyaG2:
+    def test_checker_literal(self):
+        t = independent.ktuple
+        h = History([
+            op(type="invoke", process=0, f="insert", value=t(1, [None, 1])),
+            op(type="ok", process=0, f="insert", value=t(1, [None, 1])),
+            op(type="invoke", process=1, f="insert", value=t(1, [2, None])),
+            op(type="ok", process=1, f="insert", value=t(1, [2, None]))])
+        res = adya.g2_checker().check({}, h, {})
+        assert res["valid?"] is False
+        assert res["illegal"] == {1: 2}
+        h2 = History([
+            op(type="invoke", process=0, f="insert", value=t(1, [None, 1])),
+            op(type="ok", process=0, f="insert", value=t(1, [None, 1])),
+            op(type="invoke", process=1, f="insert", value=t(1, [2, None])),
+            op(type="fail", process=1, f="insert", value=t(1, [2, None]))])
+        res = adya.g2_checker().check({}, h2, {})
+        assert res["valid?"] is True
+        assert res["legal-count"] == 1
+
+    def _run(self, client):
+        w = adya.workload({"key-count": 6})
+        test = testing.noop_test()
+        test.update(nodes=["n1"], concurrency=4, client=client,
+                    checker=w["checker"],
+                    generator=gen.clients(w["generator"]))
+        return core.run(test)
+
+    def test_serializable_client_valid(self):
+        t = self._run(G2Client())
+        assert t["results"]["valid?"] is True, t["results"]
+
+    def test_g2_anomaly_detected(self):
+        t = self._run(G2Client(broken=True))
+        assert t["results"]["valid?"] is False
+        assert t["results"]["illegal-count"] > 0

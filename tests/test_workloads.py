@@ -162,8 +162,9 @@ class TestWorkloadsEndToEnd:
 
     def test_registry_complete(self):
         assert set(workloads.REGISTRY) == {
-            "bank", "counter", "kafka", "long-fork", "queue", "register",
-            "set", "set-full", "append", "wr", "unique-ids"}
+            "adya-g2", "bank", "causal", "causal-reverse", "counter",
+            "kafka", "long-fork", "queue", "register", "set",
+            "set-full", "append", "wr", "unique-ids"}
 
 
 class TestBankCheckFast:
