@@ -97,10 +97,25 @@ class Compose(Checker):
 
     def check(self, test, hist, opts=None):
         opts = opts or {}
-        items = list(self.checker_map.items())
-        outs = util.bounded_pmap(
-            lambda kv: (kv[0], check_safe(kv[1], test, hist, opts)), items,
-            limit=8)
+        partial = opts.get("partial_results")  # crash-surviving sink
+        # sub-checkers must NOT inherit the sink: a nested compose
+        # would write its inner results flat with colliding keys (two
+        # 'stats' entries, workload results hoisted to top level)
+        sub_opts = {k: v for k, v in opts.items()
+                    if k != "partial_results"}
+
+        def one(kv):
+            name, c = kv
+            r = check_safe(c, test, hist, sub_opts)
+            if partial is not None:
+                try:
+                    partial.put(name, r)
+                except Exception:  # noqa: BLE001 — never sink the check
+                    logger.exception("writing partial result failed")
+            return name, r
+
+        outs = util.bounded_pmap(one, list(self.checker_map.items()),
+                                 limit=8)
         results = dict(outs)
         results["valid?"] = merge_valid(
             (r or {}).get("valid?") for r in results.values()

@@ -167,8 +167,11 @@ def run_case(test: dict) -> dict:
         nt.join()
 
 
-def analyze(test: dict) -> dict:
-    """Runs the checker over the history (core.clj:215-228)."""
+def analyze(test: dict, store_ctx=None) -> dict:
+    """Runs the checker over the history (core.clj:215-228). With a
+    store, composed checkers stream each sub-result to a partial-
+    results log as they finish, so a crash mid-analysis leaves the
+    completed results readable (store/format.clj PartialMap)."""
     from . import checker as jchecker
 
     logger.info("Analyzing...")
@@ -176,7 +179,22 @@ def analyze(test: dict) -> dict:
     if checker is None:
         checker = jchecker.unbridled_optimism()
     test = dict(test)
-    test["results"] = jchecker.check_safe(checker, test, test["history"])
+    opts = {}
+    partial = None
+    if store_ctx is not None:
+        try:
+            from .store import format as sformat
+            partial = sformat.PartialResultsWriter(
+                store_ctx.path(test, "results.partial.jlog"))
+            opts["partial_results"] = partial
+        except Exception:  # noqa: BLE001 — partials are best-effort
+            logger.exception("opening partial-results log failed")
+    try:
+        test["results"] = jchecker.check_safe(checker, test,
+                                              test["history"], opts)
+    finally:
+        if partial is not None:
+            partial.close()
     logger.info("Analysis complete")
     return test
 
@@ -225,7 +243,7 @@ def run(test: dict) -> dict:
             finally:
                 control.close_sessions(test)
 
-        test = analyze(test)
+        test = analyze(test, store_ctx)
         if store_ctx:
             store_ctx.save_results(test)
     finally:

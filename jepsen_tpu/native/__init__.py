@@ -1,0 +1,113 @@
+"""Native (C) components and their loader.
+
+The reference keeps native code on its store hot path
+(jepsen/src/jepsen/store/FressianReader.java — a patched binary
+deserializer — and FileOffsetOutputStream.java); here the analog is a
+small C codec for the CRC-framed history log, compiled on first use
+with the system compiler and loaded over ctypes. Everything has a pure
+Python fallback, so a missing toolchain only costs speed.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+from pathlib import Path
+
+logger = logging.getLogger(__name__)
+
+_SRC = Path(__file__).with_name("jlog.c")
+_LOCK = threading.Lock()
+_lib = None
+_tried = False
+
+
+def _build_dir() -> Path:
+    d = Path(os.environ.get("JEPSEN_TPU_NATIVE_DIR",
+                            Path.home() / ".cache" / "jepsen_tpu"))
+    d.mkdir(parents=True, exist_ok=True)
+    return d
+
+
+def _compile() -> Path | None:
+    out = _build_dir() / "jlog.so"
+    if out.exists() and out.stat().st_mtime >= _SRC.stat().st_mtime:
+        return out
+    for cc in ("cc", "gcc", "g++"):
+        try:
+            proc = subprocess.run(
+                [cc, "-O2", "-shared", "-fPIC", str(_SRC),
+                 "-o", str(out), "-lz"],
+                capture_output=True, text=True, timeout=120)
+        except (OSError, subprocess.TimeoutExpired):
+            continue
+        if proc.returncode == 0:
+            return out
+        logger.debug("%s failed to build jlog.so: %s", cc, proc.stderr)
+    return None
+
+
+def jlog() -> ctypes.CDLL | None:
+    """The compiled codec, or None (callers use the Python path)."""
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    with _LOCK:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        try:
+            path = _compile()
+            if path is None:
+                return None
+            lib = ctypes.CDLL(str(path))
+            lib.jlog_scan.restype = ctypes.c_int64
+            lib.jlog_scan.argtypes = [
+                ctypes.c_char_p, ctypes.c_int64, ctypes.c_int64,
+                ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
+                ctypes.POINTER(ctypes.c_int64)]
+            lib.jlog_frame.restype = ctypes.c_int64
+            lib.jlog_frame.argtypes = [
+                ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64),
+                ctypes.c_int64, ctypes.c_char_p]
+            _lib = lib
+        except Exception:  # noqa: BLE001 — never break the store
+            logger.exception("loading native jlog codec failed")
+            _lib = None
+        return _lib
+
+
+def scan(buf: bytes, start: int) -> tuple[list[tuple[int, int]], int]:
+    """(payload (start, end) offsets, valid_prefix_end) via the C
+    codec; raises RuntimeError if the codec is unavailable."""
+    import numpy as np
+
+    lib = jlog()
+    if lib is None:
+        raise RuntimeError("native jlog codec unavailable")
+    # generous bound: every record needs >= 8 bytes of header
+    max_records = max((len(buf) - start) // 8 + 1, 1)
+    offsets = (ctypes.c_int64 * (2 * max_records))()
+    valid_end = ctypes.c_int64(start)
+    n = lib.jlog_scan(buf, len(buf), start, offsets, max_records,
+                      ctypes.byref(valid_end))
+    # one C-speed materialization — per-item ctypes access costs more
+    # than the scan itself
+    arr = np.ctypeslib.as_array(offsets)[:2 * n].reshape(-1, 2)
+    return arr.tolist(), int(valid_end.value)
+
+
+def frame(payloads: list[bytes]) -> bytes:
+    """Concatenated framed records for payloads via the C codec;
+    raises RuntimeError if unavailable."""
+    lib = jlog()
+    if lib is None:
+        raise RuntimeError("native jlog codec unavailable")
+    blob = b"".join(payloads)
+    lens = (ctypes.c_int64 * len(payloads))(*map(len, payloads))
+    out = ctypes.create_string_buffer(len(blob) + 8 * len(payloads))
+    written = lib.jlog_frame(blob, lens, len(payloads), out)
+    return out.raw[:written]

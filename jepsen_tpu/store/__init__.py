@@ -145,10 +145,22 @@ def _stop_logging(test: dict) -> None:
 # ---------------------------------------------------------------------------
 
 def load_results(d) -> dict | None:
+    """Final results; when only the crash-surviving partial log exists
+    (the checker died mid-analysis), its completed entries come back
+    with valid? 'unknown' (store/format.clj PartialMap)."""
     p = Path(d) / "results.json"
     if p.exists():
         with open(p) as f:
             return json.load(f)
+    partial = Path(d) / "results.partial.jlog"
+    if partial.exists():
+        from . import format as fmt
+
+        got = fmt.read_partial_results(partial)
+        if got:
+            got["valid?"] = "unknown"
+            got["partial?"] = True
+            return got
     return None
 
 

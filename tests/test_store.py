@@ -4,6 +4,8 @@ jepsen/test/jepsen/store_test.clj and store/format_test.clj)."""
 
 import json
 
+import pytest
+
 from jepsen_tpu import checker, core, store, testing
 from jepsen_tpu import generator as gen
 from jepsen_tpu.history import op
@@ -136,3 +138,182 @@ def test_history_log_reopen_bad_magic_restarts(tmp_path):
     w = fmt.HistoryWriter(p)
     w.append(op(index=0, type="ok", process=0, f="read", value=1))
     assert [o.value for o in w.read_back()] == [1]
+
+
+class TestChunkedLazyHistory:
+    def test_lazy_matches_eager(self, tmp_path):
+        p = tmp_path / "history.jlog"
+        w = fmt.HistoryWriter(p, chunk_size=16)
+        for i in range(100):
+            w.append(op(index=i, time=i * 10, type="ok", process=i % 3,
+                        f="read", value=i))
+        w.close()
+        lazy = fmt.read_history_lazy(p)
+        eager = list(fmt.read_ops(p))
+        assert len(lazy) == len(eager) == 100
+        assert lazy[0].value == 0 and lazy[99].value == 99
+        assert lazy[-1].value == 99
+        assert [o.value for o in lazy] == [o.value for o in eager]
+        # index sealed 6 chunks of 16
+        assert len(fmt._read_index(p)) == 6
+
+    def test_lazy_reads_only_touched_chunks(self, tmp_path):
+        p = tmp_path / "history.jlog"
+        w = fmt.HistoryWriter(p, chunk_size=32)
+        for i in range(200):
+            w.append(op(index=i, type="ok", process=0, f="read", value=i))
+        w.close()
+        lazy = fmt.read_history_lazy(p)
+        lazy[5]
+        assert len(lazy._cache) == 1  # only one chunk decoded
+
+    def test_lazy_survives_torn_tail(self, tmp_path):
+        p = tmp_path / "history.jlog"
+        w = fmt.HistoryWriter(p, chunk_size=8)
+        for i in range(30):
+            w.append(op(index=i, type="ok", process=0, f="read", value=i))
+        w.close()
+        with open(p, "r+b") as f:
+            f.truncate(p.stat().st_size - 5)
+        lazy = fmt.read_history_lazy(p)
+        assert len(lazy) == 29
+        assert lazy[28].value == 28
+
+    def test_writer_reopen_rebuilds_index(self, tmp_path):
+        p = tmp_path / "history.jlog"
+        w = fmt.HistoryWriter(p, chunk_size=8)
+        for i in range(20):
+            w.append(op(index=i, type="ok", process=0, f="read", value=i))
+        w.close()
+        w2 = fmt.HistoryWriter(p, chunk_size=8)
+        for i in range(20, 30):
+            w2.append(op(index=i, type="ok", process=0, f="read",
+                         value=i))
+        w2.close()
+        lazy = fmt.read_history_lazy(p)
+        assert len(lazy) == 30
+        assert [o.value for o in lazy] == list(range(30))
+
+
+class TestPartialResults:
+    def test_roundtrip_and_crash_tolerance(self, tmp_path):
+        p = tmp_path / "results.partial.jlog"
+        w = fmt.PartialResultsWriter(p)
+        w.put("stats", {"valid?": True, "ok-count": 5})
+        w.put("lin", {"valid?": False})
+        w.close()
+        got = fmt.read_partial_results(p)
+        assert got["stats"]["ok-count"] == 5
+        assert got["lin"]["valid?"] is False
+        with open(p, "r+b") as f:  # torn tail drops only the tail
+            f.truncate(p.stat().st_size - 3)
+        got = fmt.read_partial_results(p)
+        assert "stats" in got
+
+    def test_compose_streams_partials(self, tmp_path):
+        from jepsen_tpu import checker as chk
+        from jepsen_tpu.history import History
+
+        class Boom(chk.Checker):
+            def check(self, test, hist, opts=None):
+                raise RuntimeError("checker crashed")
+
+        p = tmp_path / "results.partial.jlog"
+        w = fmt.PartialResultsWriter(p)
+        hist = History([op(type="invoke", process=0, f="read", value=None),
+                        op(type="ok", process=0, f="read", value=1)])
+        c = chk.compose({"stats": chk.stats(), "boom": Boom()})
+        res = c.check({}, hist, {"partial_results": w})
+        w.close()
+        got = fmt.read_partial_results(p)
+        assert got["stats"]["valid?"] is True
+        assert got["boom"]["valid?"] == "unknown"
+        assert res["valid?"] == "unknown"
+
+    def test_load_results_falls_back_to_partials(self, tmp_path):
+        w = fmt.PartialResultsWriter(tmp_path / "results.partial.jlog")
+        w.put("stats", {"valid?": True})
+        w.close()
+        got = store.load_results(tmp_path)
+        assert got["partial?"] is True
+        assert got["valid?"] == "unknown"
+        assert got["stats"]["valid?"] is True
+
+
+class TestNativeCodec:
+    def test_native_scan_agrees_with_python(self, tmp_path):
+        from jepsen_tpu import native
+
+        if native.jlog() is None:
+            import pytest
+            pytest.skip("no C toolchain")
+        p = tmp_path / "history.jlog"
+        w = fmt.HistoryWriter(p)
+        for i in range(50):
+            w.append(op(index=i, type="ok", process=0, f="read",
+                        value={"deep": [i, "x"]}))
+        w.close()
+        buf = p.read_bytes()
+        offs, end = native.scan(buf, len(fmt.MAGIC))
+        assert len(offs) == 50
+        assert end == len(buf)
+        # torn tail: native stops exactly where python does
+        with open(p, "r+b") as f:
+            f.truncate(p.stat().st_size - 2)
+        buf = p.read_bytes()
+        offs, end = native.scan(buf, len(fmt.MAGIC))
+        assert len(offs) == 49
+        assert end == fmt._valid_prefix_end(p)
+
+    def test_native_frame_matches_python(self):
+        from jepsen_tpu import native
+
+        if native.jlog() is None:
+            import pytest
+            pytest.skip("no C toolchain")
+        import json as j
+        import struct
+        import zlib
+
+        payloads = [j.dumps({"i": i}).encode() for i in range(20)]
+        H = struct.Struct("<II")
+        exp = b"".join(H.pack(len(x), zlib.crc32(x)) + x
+                       for x in payloads)
+        assert native.frame(payloads) == exp
+
+
+class TestStoreReviewRegressions:
+    def test_lazy_bad_magic_raises_cleanly(self, tmp_path):
+        p = tmp_path / "history.jlog"
+        p.write_bytes(b"")
+        with pytest.raises((ValueError, OSError)):
+            fmt.read_history_lazy(p)
+        p.write_bytes(b"garbage!")
+        with pytest.raises(ValueError):
+            fmt.read_history_lazy(p)
+
+    def test_bulk_write_history_roundtrip(self, tmp_path):
+        p = tmp_path / "history.jlog"
+        ops = [op(index=i, time=i, type="ok", process=0, f="read",
+                  value=i) for i in range(1000)]
+        fmt.write_history(p, ops, chunk_size=128)
+        lazy = fmt.read_history_lazy(p)
+        assert len(lazy) == 1000
+        assert [o.value for o in lazy] == list(range(1000))
+        assert len(fmt._read_index(p)) == 1000 // 128
+
+    def test_nested_compose_does_not_pollute_partials(self, tmp_path):
+        from jepsen_tpu import checker as chk
+        from jepsen_tpu.history import History
+
+        w = fmt.PartialResultsWriter(tmp_path / "r.jlog")
+        inner = chk.compose({"stats": chk.stats(),
+                             "bank-ish": chk.unbridled_optimism()})
+        outer = chk.compose({"workload": inner, "stats": chk.stats()})
+        hist = History([op(type="invoke", process=0, f="read", value=None),
+                        op(type="ok", process=0, f="read", value=1)])
+        outer.check({}, hist, {"partial_results": w})
+        w.close()
+        got = fmt.read_partial_results(tmp_path / "r.jlog")
+        assert set(got) == {"workload", "stats"}  # no inner flattening
+        assert got["workload"]["bank-ish"]["valid?"] is True
