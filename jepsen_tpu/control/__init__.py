@@ -119,6 +119,11 @@ def su(user: str = "root"):
 
 @contextmanager
 def cd(directory: str):
+    cur = _dir.get()
+    if cur and not str(directory).startswith("/"):
+        # nested relative cd joins, like a shell: cd(a) inside cd(b)
+        # means b/a, not a-relative-to-the-login-dir
+        directory = f"{cur}/{directory}"
     tok = _dir.set(directory)
     try:
         yield

@@ -1,0 +1,194 @@
+"""fs-cache tests: atomic writes, typed load/save, remote deploy over
+the dummy remote (mirror jepsen/src/jepsen/fs_cache.clj)."""
+
+import threading
+
+import pytest
+
+from jepsen_tpu import control, fs_cache, testing
+from jepsen_tpu.control.core import Action
+from jepsen_tpu.control.dummy import DummyRemote
+
+
+@pytest.fixture(autouse=True)
+def cache_dir(tmp_path, monkeypatch):
+    monkeypatch.setenv("JEPSEN_TPU_CACHE_DIR", str(tmp_path / "cache"))
+    yield
+
+
+def test_string_roundtrip():
+    assert not fs_cache.cached_p(["foo", 1])
+    assert fs_cache.load_string(["foo", 1]) is None
+    fs_cache.save_string("hello", ["foo", 1])
+    assert fs_cache.cached_p(["foo", 1])
+    assert fs_cache.load_string(["foo", 1]) == "hello"
+
+
+def test_data_roundtrip():
+    fs_cache.save_data({"a": [1, 2], "b": None}, ["db", "license"])
+    assert fs_cache.load_data(["db", "license"]) == {"a": [1, 2],
+                                                    "b": None}
+
+
+def test_path_encoding_weird_parts():
+    fs_cache.save_string("x", ["a/b", True, 3, None])
+    assert fs_cache.load_string(["a/b", True, 3, None]) == "x"
+    # slash must not escape the cache dir
+    f = fs_cache.file(["a/b"])
+    assert "a%2Fb" in str(f)
+
+
+def test_file_roundtrip(tmp_path):
+    src = tmp_path / "artifact.bin"
+    src.write_bytes(b"\x00\x01payload")
+    fs_cache.save_file(src, ["bin", "v1"])
+    got = fs_cache.load_file(["bin", "v1"])
+    assert got is not None and got.read_bytes() == b"\x00\x01payload"
+
+
+def test_atomic_write_no_partial_on_error(tmp_path):
+    class Boom(Exception):
+        pass
+
+    with pytest.raises(Boom):
+        with fs_cache._atomic(fs_cache.file(["x"])) as tmp:
+            tmp.write_text("partial")
+            raise Boom()
+    assert not fs_cache.cached_p(["x"])
+
+
+def test_deploy_remote(tmp_path):
+    src = tmp_path / "bin"
+    src.write_text("binary!")
+    fs_cache.save_file(src, ["tool"])
+    remote = DummyRemote()
+    test = testing.noop_test()
+    test.update(nodes=["n1"],
+                remote=remote,
+                sessions={"n1": remote.connect({"host": "n1"})})
+    with control.with_session(test, "n1"):
+        fs_cache.deploy_remote(["tool"], "/opt/bin/tool")
+    log = test["sessions"]["n1"].log
+    cmds = [a.cmd for a in log if isinstance(a, Action)]
+    assert any("rm -rf /opt/bin/tool" in c for c in cmds)
+    assert any("mkdir -p /opt/bin" in c for c in cmds)
+    uploads = [e for e in log if isinstance(e, tuple) and e[0] == "upload"]
+    assert uploads and uploads[0][2] == "/opt/bin/tool"
+
+
+def test_deploy_uncached_raises():
+    with pytest.raises(RuntimeError):
+        fs_cache.deploy_remote(["nope"], "/opt/x")
+
+
+def test_deploy_suspicious_path_raises(tmp_path):
+    src = tmp_path / "f"
+    src.write_text("x")
+    fs_cache.save_file(src, ["f"])
+    with pytest.raises(ValueError):
+        fs_cache.deploy_remote(["f"], "/etc")
+
+
+def test_locking_serializes():
+    order = []
+
+    def worker(i):
+        with fs_cache.locking(["expensive"]):
+            order.append(("in", i))
+            order.append(("out", i))
+
+    ts = [threading.Thread(target=worker, args=(i,)) for i in range(4)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    # never two 'in's without an 'out' between them
+    depth = 0
+    for kind, _ in order:
+        depth += 1 if kind == "in" else -1
+        assert 0 <= depth <= 1
+
+
+def test_centos_os_commands():
+    from jepsen_tpu.control.core import Result
+    from jepsen_tpu.os_setup import centos
+
+    def responder(node, action):
+        if action.cmd.startswith("rpm -qa"):
+            return Result(exit=0, out="wget\ncurl\n", err="",
+                          cmd=action.cmd)
+        if action.cmd.startswith("stat "):
+            return Result(exit=1, out="", err="absent", cmd=action.cmd)
+        return None
+
+    remote = DummyRemote(responder)
+    test = testing.noop_test()
+    test.update(nodes=["n1"], remote=remote,
+                sessions={"n1": remote.connect({"host": "n1"})})
+    with control.with_session(test, "n1"):
+        centos.os.setup(test, "n1")
+    cmds = [a.cmd for a in test["sessions"]["n1"].log
+            if isinstance(a, Action)]
+    joined = " ; ".join(cmds)
+    yum = next(c for c in cmds if "yum -y install" in c)
+    assert "gcc" in yum
+    # wget/curl report installed via rpm -qa: not re-installed
+    assert " wget" not in yum and " curl " not in yum + " "
+    assert "start-stop-daemon" in joined  # built from dpkg source
+
+
+class TestReviewRegressions:
+    def test_dotdot_cannot_escape_cache(self):
+        fs_cache.save_string("x", ["..", "evil"])
+        f = fs_cache.file(["..", "evil"])
+        base = fs_cache._base().resolve()
+        assert base in f.resolve().parents
+
+    def test_relative_deploy_path_rejected(self, tmp_path):
+        src = tmp_path / "f"
+        src.write_text("x")
+        fs_cache.save_file(src, ["g"])
+        with pytest.raises(ValueError):
+            fs_cache.deploy_remote(["g"], "tmp/sub/file")
+
+    def test_scalar_and_list_paths_share_a_lock(self):
+        import time
+
+        order = []
+
+        def one(spelling):
+            with fs_cache.locking(spelling):
+                order.append("in")
+                time.sleep(0.01)
+                order.append("out")
+
+        ts = [threading.Thread(target=one, args=(s,))
+              for s in ("same", ["same"])]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert order == ["in", "out", "in", "out"]
+
+    def test_save_data_rejects_non_json(self):
+        from pathlib import Path
+
+        with pytest.raises(TypeError):
+            fs_cache.save_data({"v": Path("/x")}, ["bad"])
+        assert not fs_cache.cached_p(["bad"])
+
+    def test_centos_daemon_build_runs_in_workdir(self):
+        from jepsen_tpu.control.core import Result
+        from jepsen_tpu.os_setup import centos
+
+        remote = DummyRemote()
+        test = testing.noop_test()
+        test.update(nodes=["n1"], remote=remote,
+                    sessions={"n1": remote.connect({"host": "n1"})})
+        with control.with_session(test, "n1"):
+            centos.install_start_stop_daemon()
+        acts = [a for a in test["sessions"]["n1"].log
+                if isinstance(a, Action)]
+        cp = next(a for a in acts if a.cmd.startswith("cp "))
+        assert cp.dir == "/tmp/jepsen/dpkg-build/dpkg-1.17.27"
+        assert "utils/start-stop-daemon" in cp.cmd
