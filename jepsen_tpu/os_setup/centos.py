@@ -13,7 +13,7 @@ import logging
 
 from .. import control
 from ..control import util as cu
-from . import debian
+from . import OS, debian
 
 logger = logging.getLogger(__name__)
 
@@ -65,7 +65,7 @@ def install_start_stop_daemon() -> None:
         control.exec_("rm", "-rf", workdir)
 
 
-class CentOS:
+class CentOS(OS):
     """OS protocol impl (os.clj:4-9) for CentOS nodes."""
 
     packages = PACKAGES

@@ -109,34 +109,6 @@ def test_locking_serializes():
         assert 0 <= depth <= 1
 
 
-def test_centos_os_commands():
-    from jepsen_tpu.control.core import Result
-    from jepsen_tpu.os_setup import centos
-
-    def responder(node, action):
-        if action.cmd.startswith("rpm -qa"):
-            return Result(exit=0, out="wget\ncurl\n", err="",
-                          cmd=action.cmd)
-        if action.cmd.startswith("stat "):
-            return Result(exit=1, out="", err="absent", cmd=action.cmd)
-        return None
-
-    remote = DummyRemote(responder)
-    test = testing.noop_test()
-    test.update(nodes=["n1"], remote=remote,
-                sessions={"n1": remote.connect({"host": "n1"})})
-    with control.with_session(test, "n1"):
-        centos.os.setup(test, "n1")
-    cmds = [a.cmd for a in test["sessions"]["n1"].log
-            if isinstance(a, Action)]
-    joined = " ; ".join(cmds)
-    yum = next(c for c in cmds if "yum -y install" in c)
-    assert "gcc" in yum
-    # wget/curl report installed via rpm -qa: not re-installed
-    assert " wget" not in yum and " curl " not in yum + " "
-    assert "start-stop-daemon" in joined  # built from dpkg source
-
-
 class TestReviewRegressions:
     def test_dotdot_cannot_escape_cache(self):
         fs_cache.save_string("x", ["..", "evil"])
@@ -176,19 +148,3 @@ class TestReviewRegressions:
         with pytest.raises(TypeError):
             fs_cache.save_data({"v": Path("/x")}, ["bad"])
         assert not fs_cache.cached_p(["bad"])
-
-    def test_centos_daemon_build_runs_in_workdir(self):
-        from jepsen_tpu.control.core import Result
-        from jepsen_tpu.os_setup import centos
-
-        remote = DummyRemote()
-        test = testing.noop_test()
-        test.update(nodes=["n1"], remote=remote,
-                    sessions={"n1": remote.connect({"host": "n1"})})
-        with control.with_session(test, "n1"):
-            centos.install_start_stop_daemon()
-        acts = [a for a in test["sessions"]["n1"].log
-                if isinstance(a, Action)]
-        cp = next(a for a in acts if a.cmd.startswith("cp "))
-        assert cp.dir == "/tmp/jepsen/dpkg-build/dpkg-1.17.27"
-        assert "utils/start-stop-daemon" in cp.cmd

@@ -1,0 +1,76 @@
+"""OS-layer tests: CentOS (yum/rpm, start-stop-daemon source build)
+and SmartOS (pkgin, ipfilter) command emission over the dummy remote
+(mirror jepsen/src/jepsen/os/centos.clj, smartos.clj)."""
+
+from jepsen_tpu import control, testing
+from jepsen_tpu.control.core import Action
+from jepsen_tpu.control.dummy import DummyRemote
+
+
+def test_centos_os_commands():
+    from jepsen_tpu.control.core import Result
+    from jepsen_tpu.os_setup import centos
+
+    def responder(node, action):
+        if action.cmd.startswith("rpm -qa"):
+            return Result(exit=0, out="wget\ncurl\n", err="",
+                          cmd=action.cmd)
+        if action.cmd.startswith("stat "):
+            return Result(exit=1, out="", err="absent", cmd=action.cmd)
+        return None
+
+    remote = DummyRemote(responder)
+    test = testing.noop_test()
+    test.update(nodes=["n1"], remote=remote,
+                sessions={"n1": remote.connect({"host": "n1"})})
+    with control.with_session(test, "n1"):
+        centos.os.setup(test, "n1")
+    cmds = [a.cmd for a in test["sessions"]["n1"].log
+            if isinstance(a, Action)]
+    joined = " ; ".join(cmds)
+    yum = next(c for c in cmds if "yum -y install" in c)
+    assert "gcc" in yum
+    # wget/curl report installed via rpm -qa: not re-installed
+    assert " wget" not in yum and " curl " not in yum + " "
+    assert "start-stop-daemon" in joined  # built from dpkg source
+
+
+def test_smartos_os_commands():
+    from jepsen_tpu.control.core import Result
+    from jepsen_tpu.os_setup import smartos
+
+    def responder(node, action):
+        if action.cmd.startswith("pkgin -p list"):
+            return Result(exit=0, out="curl-8.0\nwget-1.21\n", err="",
+                          cmd=action.cmd)
+        return None
+
+    remote = DummyRemote(responder)
+    test = testing.noop_test()
+    test.update(nodes=["n1"], remote=remote,
+                sessions={"n1": remote.connect({"host": "n1"})})
+    with control.with_session(test, "n1"):
+        smartos.os.setup(test, "n1")
+    cmds = [a.cmd for a in test["sessions"]["n1"].log
+            if isinstance(a, Action)]
+    inst = next(c for c in cmds if "pkgin -y install" in c)
+    assert "gcc10" in inst and "curl" not in inst.split("install")[1]
+    assert any("svcadm enable -r ipfilter" in c for c in cmds)
+
+
+class TestCentOSRegressions:
+    def test_centos_daemon_build_runs_in_workdir(self):
+        from jepsen_tpu.control.core import Result
+        from jepsen_tpu.os_setup import centos
+
+        remote = DummyRemote()
+        test = testing.noop_test()
+        test.update(nodes=["n1"], remote=remote,
+                    sessions={"n1": remote.connect({"host": "n1"})})
+        with control.with_session(test, "n1"):
+            centos.install_start_stop_daemon()
+        acts = [a for a in test["sessions"]["n1"].log
+                if isinstance(a, Action)]
+        cp = next(a for a in acts if a.cmd.startswith("cp "))
+        assert cp.dir == "/tmp/jepsen/dpkg-build/dpkg-1.17.27"
+        assert "utils/start-stop-daemon" in cp.cmd
