@@ -12,6 +12,7 @@ Headline (printed LAST, the line the driver records):
 Also printed (one JSON line each, config 2 last):
   config 3 — elle list-append dependency-cycle check, 100k txns
              (device engine: interned arrays + batched SCC)
+  config 3b — elle rw-register cycle check, 100k txns (device SCC)
   config 4 — bank balance-conservation check, 500k txns (array fold)
   config 5 — 1024-history ensemble checked in one batched launch
 
@@ -34,34 +35,53 @@ def _log(msg):
     print(f"# {msg}", file=sys.stderr)
 
 
-def bench_list_append(n_txns=100_000):
-    from jepsen_tpu.tpu import elle, synth
-
-    t0 = time.time()
-    hist = synth.list_append_history(n_txns, seed=11)
-    _log(f"config3: generated {n_txns} txns in {time.time() - t0:.1f}s")
-    elle.check_list_append(hist)  # warm: XLA compile out of timed region
+def _bench_elle(label, metric, hist, check_fn):
+    """Shared elle-config protocol: warm once, median of 3 device runs
+    vs median of 3 host-engine runs."""
+    check_fn(hist)  # warm: XLA compile out of timed region
     times = []
     for _ in range(3):
         t0 = time.time()
-        res = elle.check_list_append(hist)
+        res = check_fn(hist)
         times.append(time.time() - t0)
     assert res["valid?"] is True, res
     dev = statistics.median(times)
     host_times = []
     for _ in range(3):
         t0 = time.time()
-        host = elle.check_list_append(hist, {"engine": "host"})
+        host = check_fn(hist, {"engine": "host"})
         host_times.append(time.time() - t0)
     host_s = statistics.median(host_times)
     assert host["valid?"] is True
-    _log(f"config3: device {dev:.2f}s host {host_s:.2f}s")
+    _log(f"{label}: device {dev:.2f}s host {host_s:.2f}s")
     return {
-        "metric": f"elle list-append cycle check ({n_txns // 1000}k txns)",
-        "value": round(n_txns / dev, 1),
+        "metric": metric,
+        "value": round(len(hist) // 2 / dev, 1),
         "unit": "txns/s",
         "vs_baseline": round(host_s / dev, 2),
     }
+
+
+def bench_list_append(n_txns=100_000):
+    from jepsen_tpu.tpu import elle, synth
+
+    t0 = time.time()
+    hist = synth.list_append_history(n_txns, seed=11)
+    _log(f"config3: generated {n_txns} txns in {time.time() - t0:.1f}s")
+    return _bench_elle(
+        "config3", f"elle list-append cycle check ({n_txns // 1000}k txns)",
+        hist, elle.check_list_append)
+
+
+def bench_rw_register(n_txns=100_000):
+    from jepsen_tpu.tpu import elle, synth
+
+    t0 = time.time()
+    hist = synth.rw_register_history(n_txns, seed=17)
+    _log(f"config3b: generated {n_txns} rw txns in {time.time() - t0:.1f}s")
+    return _bench_elle(
+        "config3b", f"elle rw-register cycle check ({n_txns // 1000}k txns)",
+        hist, elle.check_rw_register)
 
 
 def bench_bank(n_txns=500_000):
@@ -197,6 +217,8 @@ def main():
     lines = []
     if not os.environ.get("BENCH_SKIP_EXTRAS"):
         for fn, args in ((bench_list_append,
+                          (10_000 if small else 100_000,)),
+                         (bench_rw_register,
                           (10_000 if small else 100_000,)),
                          (bench_bank, (50_000 if small else 500_000,)),
                          (bench_ensemble, (128 if small else 1024,))):

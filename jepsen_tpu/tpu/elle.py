@@ -460,7 +460,11 @@ def check_rw_register(hist, opts: dict | None = None) -> dict:
     assuming distinct written values per key (the generator's
     guarantee). Proven edges only: wr (read-from), ww via
     write-follows-read within a txn, rw against the successor in the
-    proven version chain, plus process/realtime order."""
+    proven version chain, plus process/realtime order.
+
+    opts["engine"]: "host" (scipy SCC per graded subset), "device"
+    (batched SCC kernel with a clean-graph early exit), or "auto"
+    (default: device for large histories)."""
     if not isinstance(hist, History):
         hist = History(hist)
     txns = collect(hist)
@@ -550,7 +554,20 @@ def check_rw_register(hist, opts: dict | None = None) -> dict:
                     edges.append((t.i, w.i, RW))
     edges.extend(_order_edges([t for t in txns if t.type == h.OK]))
 
-    for name, ws in cycle_anomalies(len(txns), edges, txns).items():
+    engine = (opts or {}).get("engine", "auto")
+    if engine == "device" or (engine == "auto"
+                              and len(hist) >= _DEVICE_MIN_OPS):
+        # route cycle detection through the batched SCC kernel: one
+        # full-graph pass proves clean histories, graded subsets run
+        # only when cycles exist (same dispatch as list-append)
+        from . import elle_device
+
+        e = np.asarray(edges, dtype=np.int64).reshape(-1, 3)
+        cyc = elle_device.cycle_anomalies_arrays(
+            len(txns), e[:, 0], e[:, 1], e[:, 2], txns)
+    else:
+        cyc = cycle_anomalies(len(txns), edges, txns)
+    for name, ws in cyc.items():
         anomalies[name] = ws
     return {
         "valid?": not anomalies,

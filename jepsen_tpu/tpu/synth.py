@@ -171,3 +171,49 @@ def bank_history(n_txns: int, n_procs: int = 5, n_accounts: int = 8,
     ops = [op(index=i, time=i, type=t, process=p, f=f, value=v)
            for i, (t, p, f, v) in enumerate(events)]
     return History(ops, assign_indices=False)
+
+
+def rw_register_history(n_txns: int, n_procs: int = 5,
+                        n_keys: int = 32, max_len: int = 4,
+                        seed: int = 0) -> History:
+    """A valid concurrent rw-register txn history: writes apply to true
+    registers at completion, reads snapshot them, every written value
+    unique (elle's rw-register generator guarantee). BASELINE config 3
+    fodder alongside list_append_history."""
+    rng = random.Random(seed)
+    regs: dict = {}
+    events: list = []
+    open_t: dict[int, list] = {}
+    nv = 1
+    t_count = 0
+    while t_count < n_txns or open_t:
+        idle = n_procs - len(open_t)
+        if t_count < n_txns and idle and (rng.random() < 0.6
+                                          or not open_t):
+            p = rng.choice([q for q in range(n_procs)
+                            if q not in open_t])
+            txn = []
+            for _ in range(rng.randint(1, max_len)):
+                k = f"k{rng.randrange(n_keys)}"
+                if rng.random() < 0.5:
+                    txn.append(["w", k, nv])
+                    nv += 1
+                else:
+                    txn.append(["r", k, None])
+            events.append(("invoke", p, txn))
+            open_t[p] = txn
+            t_count += 1
+        else:
+            p = rng.choice(list(open_t))
+            txn = open_t.pop(p)
+            res = []
+            for f, k, v in txn:
+                if f == "w":
+                    regs[k] = v
+                    res.append(["w", k, v])
+                else:
+                    res.append(["r", k, regs.get(k)])
+            events.append(("ok", p, res))
+    ops = [op(index=i, time=i, type=t, process=p, f="txn", value=m)
+           for i, (t, p, m) in enumerate(events)]
+    return History(ops, assign_indices=False)

@@ -255,3 +255,33 @@ def test_unobservable_last_element_still_gets_rw():
     rd = elle_device.check_list_append_device(hist)
     assert rh["valid?"] == rd["valid?"]
     assert rh["anomaly-types"] == rd["anomaly-types"], (rh, rd)
+
+
+class TestRwRegisterDeviceDispatch:
+    """check_rw_register's device-SCC dispatch must agree with the
+    host cycle search (BASELINE config 3 covers rw-register too)."""
+
+    def test_engines_agree_on_fixtures(self):
+        cases = [
+            # valid
+            T(("invoke", 0, [["w", "x", 1]]), ("ok", 0, [["w", "x", 1]]),
+              ("invoke", 1, [["r", "x", None]]), ("ok", 1, [["r", "x", 1]])),
+            # wr cycle (G1c)
+            T(("invoke", 0, [["w", "x", 1], ["r", "y", None]]),
+              ("invoke", 1, [["w", "y", 2], ["r", "x", None]]),
+              ("ok", 0, [["w", "x", 1], ["r", "y", 2]]),
+              ("ok", 1, [["w", "y", 2], ["r", "x", 1]])),
+        ]
+        for hist in cases:
+            rd = elle.check_rw_register(hist, {"engine": "device"})
+            rh = elle.check_rw_register(hist, {"engine": "host"})
+            assert rd["valid?"] == rh["valid?"]
+            assert rd["anomaly-types"] == rh["anomaly-types"]
+
+    def test_engines_agree_on_generated(self):
+        from jepsen_tpu.tpu import synth
+
+        hist = synth.rw_register_history(2000, seed=9)
+        rd = elle.check_rw_register(hist, {"engine": "device"})
+        rh = elle.check_rw_register(hist, {"engine": "host"})
+        assert rd["valid?"] is rh["valid?"] is True
