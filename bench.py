@@ -212,6 +212,9 @@ def bench_headline(n_events):
 
 
 def main():
+    from jepsen_tpu.tpu import dist
+
+    dist.ensure_initialized()  # before the first JAX computation
     n_events = int(os.environ.get("BENCH_OPS", "1000000"))
     small = n_events < 1_000_000
     lines = []

@@ -189,9 +189,14 @@ def analyze(test: dict, store_ctx=None) -> dict:
             opts["partial_results"] = partial
         except Exception:  # noqa: BLE001 — partials are best-effort
             logger.exception("opening partial-results log failed")
+    trace_dir = test.get("profile_dir")
+    if trace_dir is None and store_ctx is not None and test.get(
+            "profile?"):
+        trace_dir = store_ctx.path(test, "xprof")
     try:
-        test["results"] = jchecker.check_safe(checker, test,
-                                              test["history"], opts)
+        with util.profile_trace(trace_dir):
+            test["results"] = jchecker.check_safe(checker, test,
+                                                  test["history"], opts)
     finally:
         if partial is not None:
             partial.close()
@@ -213,6 +218,13 @@ def log_results(test: dict) -> dict:
 
 def run(test: dict) -> dict:
     """Full lifecycle (core.clj:322-412)."""
+    # multi-host analysis: jax.distributed must initialize before the
+    # first JAX computation, so it happens at lifecycle entry
+    try:
+        from .tpu import dist
+        dist.ensure_initialized()
+    except ImportError:
+        pass
     test = prepare_test(test)
 
     store_ctx = None

@@ -39,9 +39,14 @@ def _jitted_sharded(mesh, W: int, F: int, max_iters: int, reach: bool):
 
 
 def default_mesh(n_devices: int | None = None):
-    """A 1-D mesh over the first n (default: all) local devices."""
+    """A 1-D mesh over the first n (default: all) devices; with the
+    multi-host env set (tpu/dist.py), 'all' spans every host's chips
+    and the batch axis shards across DCN."""
     import jax
 
+    from . import dist
+
+    dist.ensure_initialized()
     devs = jax.devices()
     if n_devices is not None:
         devs = devs[:n_devices]

@@ -345,3 +345,21 @@ def fraction_of(frac: float | str, n: int) -> int:
     if isinstance(frac, str) and frac.endswith("%"):
         return max(1, math.floor(n * float(frac[:-1]) / 100))
     return int(frac)
+
+
+@contextmanager
+def profile_trace(trace_dir=None):
+    """Captures a JAX/XLA profiler trace (xplane protobufs viewable in
+    TensorBoard/xprof) around the body when trace_dir is set; no-op
+    otherwise. The kernel-level profiling hook SURVEY §5 calls for on
+    top of the op-level trace combinator and perf plots."""
+    if not trace_dir:
+        yield
+        return
+    try:
+        import jax
+    except ImportError:
+        yield
+        return
+    with jax.profiler.trace(str(trace_dir)):
+        yield
