@@ -782,31 +782,43 @@ def analysis(model, hist, algorithm: str = "tpu", W: int = 32,
     return out
 
 
-def analysis_batch(model, hists: Sequence, W: int = 32,
-                   F: int = 64) -> list[dict]:
-    """Checks many histories at once (the ensemble path: one device
-    launch for the whole batch, host fallback only for UNKNOWNs)."""
-    encs = []
-    fallback: dict[int, dict] = {}
-    idx_map = []
-    for i, hh in enumerate(hists):
-        if not isinstance(hh, History):
-            hh = History(hh)
-        try:
-            encs.append(encode(model, hh))
-            idx_map.append(i)
-        except EncodingError:
-            out = search_host_model(model, hh, witness=True)
-            out["analyzer"] = "model"
-            fallback[i] = out
+def analysis_batch_streamed(model, hists: Sequence, chunk: int = 256,
+                            W: int = 32, F: int = 64) -> list[dict]:
+    """analysis_batch with host->HBM pipelining (SURVEY P7): histories
+    are encoded and launched chunk by chunk, and because JAX dispatch
+    is asynchronous, chunk i+1's host-side encoding overlaps chunk i's
+    device search. A one-chunk drain lag keeps at most two chunks of
+    packed tensors live on the host while preserving the overlap."""
+    hists = list(hists)
     results: list[dict] = [None] * len(hists)  # type: ignore
-    for i, out in fallback.items():
-        results[i] = out
-    if encs:
+
+    def launch(group, start):
+        encs = []
+        idx_map = []
+        for off, hh in enumerate(group):
+            i = start + off
+            if not isinstance(hh, History):
+                hh = History(hh)
+            try:
+                encs.append(encode(model, hh))
+                idx_map.append(i)
+            except EncodingError:
+                out = search_host_model(model, hh, witness=True)
+                out["analyzer"] = "model"
+                results[i] = out
+        if not encs:
+            return None
         try:
-            res = check_batch(encs, W=W, F=F)
+            pb = PackedBatch(encs)
+            rows = [(j, e.init_state) for j, e in enumerate(encs)]
+            return _launch(pb, rows, W, F, reach=False), encs, idx_map
         except RangeError:
-            res = [UNKNOWN] * len(encs)
+            return None, encs, idx_map
+
+    def drain(entry):
+        dev, encs, idx_map = entry
+        res = (np.asarray(dev)[:len(encs)] if dev is not None
+               else [UNKNOWN] * len(encs))
         for j, i in enumerate(idx_map):
             r = int(res[j])
             if r == VALID:
@@ -816,4 +828,24 @@ def analysis_batch(model, hists: Sequence, W: int = 32,
                 out["analyzer"] = ("tpu" if r == INVALID
                                    else "tpu+host-fallback")
                 results[i] = out
+
+    pending = None
+    for start in range(0, len(hists), chunk):
+        entry = launch(hists[start:start + chunk], start)
+        # drain the PREVIOUS chunk now: the current one is already
+        # dispatched, so the device keeps working while we decode
+        if pending is not None:
+            drain(pending)
+        pending = entry
+    if pending is not None:
+        drain(pending)
     return results
+
+
+def analysis_batch(model, hists: Sequence, W: int = 32,
+                   F: int = 64) -> list[dict]:
+    """Checks many histories at once (the ensemble path: one device
+    launch for the whole batch, host fallback only for UNKNOWNs)."""
+    hists = list(hists)
+    return analysis_batch_streamed(model, hists,
+                                   chunk=max(len(hists), 1), W=W, F=F)

@@ -169,3 +169,26 @@ def test_graft_entry_dryrun():
     finally:
         sys.path.pop(0)
     ge.dryrun_multichip(8)
+
+
+class TestStreamedBatch:
+    def test_matches_one_shot_batch(self):
+        from jepsen_tpu.checker import models
+        from jepsen_tpu.tpu import synth, wgl
+
+        hists = [synth.register_history(60, n_procs=3, seed=100 + i,
+                                        crash_p=0.1 if i % 3 else 0.0)
+                 for i in range(40)]
+        # corrupt one history so valid/invalid both flow through
+        bad = hists[7]
+        ops = list(bad)
+        from jepsen_tpu.history import History, op as mkop
+        ops.append(mkop(type="invoke", process=0, f="read", value=None))
+        ops.append(mkop(type="ok", process=0, f="read", value=424242))
+        hists[7] = History(ops)
+        model = models.cas_register()
+        one = wgl.analysis_batch(model, hists)
+        streamed = wgl.analysis_batch_streamed(model, hists, chunk=16)
+        assert [r["valid?"] for r in one] == \
+            [r["valid?"] for r in streamed]
+        assert streamed[7]["valid?"] is False
