@@ -630,8 +630,8 @@ def segment_cuts(enc: Encoded, target_len: int = 2048,
     return cuts
 
 
-def check_segmented(enc: Encoded, target_len: int = 2048, W: int = 32,
-                    F: int = 64, witness: bool = False,
+def check_segmented(enc: Encoded, target_len: int = 2048, W: int = 24,
+                    F: int = 48, witness: bool = False,
                     prefix_screen: int = 96) -> dict | None:
     """Checks one long history by cutting it into segments, computing
     per-(segment, start-state) final-state reachability in ONE batched
@@ -729,8 +729,8 @@ def check_segmented(enc: Encoded, target_len: int = 2048, W: int = 32,
 # Public analysis API (knossos-analysis-shaped results)
 # ---------------------------------------------------------------------------
 
-def analysis(model, hist, algorithm: str = "tpu", W: int = 32,
-             F: int = 64) -> dict:
+def analysis(model, hist, algorithm: str = "tpu", W: int | None = None,
+             F: int | None = None) -> dict:
     """Checks a single history against a model.
 
     algorithm: 'tpu'  — device kernel, host fallback on UNKNOWN
@@ -759,14 +759,24 @@ def analysis(model, hist, algorithm: str = "tpu", W: int = 32,
 
     # Long histories: segment-parallel path (one batched launch over
     # segments x start-states instead of m sequential frontier steps).
+    # W/F default per path: the prefix-screened segmented search runs
+    # leaner (24/48, unknowns fall back soundly) than the whole-history
+    # kernel (32/64).
     if enc.m >= 4096:
-        seg = check_segmented(enc, W=W, F=F, witness=True)
+        seg_kw = {}
+        if W is not None:
+            seg_kw["W"] = W
+        if F is not None:
+            seg_kw["F"] = F
+        seg = check_segmented(enc, witness=True, **seg_kw)
         if seg is not None:
             seg["analyzer"] = "tpu-segmented"
             return seg
 
     try:
-        res = int(check_batch([enc], W=W, F=F)[0])
+        res = int(check_batch([enc],
+                              W=W if W is not None else 32,
+                              F=F if F is not None else 64)[0])
     except RangeError:
         out = search_host(enc, witness=True)
         out["analyzer"] = "wgl"
