@@ -12,7 +12,7 @@ from .core import (Nemesis, NoopNemesis, Validate, noop, validate, invoke,
                    partition_random_halves, partition_random_node,
                    partition_majorities_ring,
                    node_start_stopper, hammer_time, truncate_file,
-                   bitflip)
+                   bitflip, start_stop_cycle)
 
 __all__ = [
     "Nemesis", "NoopNemesis", "Validate", "noop", "validate", "invoke",
@@ -21,4 +21,5 @@ __all__ = [
     "partitioner", "partition_halves", "partition_random_halves",
     "partition_random_node", "partition_majorities_ring",
     "node_start_stopper", "hammer_time", "truncate_file", "bitflip",
+    "start_stop_cycle",
 ]

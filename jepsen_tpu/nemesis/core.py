@@ -491,3 +491,15 @@ def partition_random_node() -> Partitioner:
 def partition_majorities_ring() -> Partitioner:
     """Overlapping-majorities ring partition."""
     return Partitioner(majorities_ring)
+
+
+def start_stop_cycle(period: float = 5.0):
+    """The canonical nemesis schedule: sleep, start fault, sleep, stop,
+    repeat (the gen/cycle in every tutorial-grade suite,
+    zookeeper.clj:129-133)."""
+    from .. import generator as gen
+
+    return gen.cycle(gen.phases(gen.sleep(period),
+                                {"type": "info", "f": "start"},
+                                gen.sleep(period),
+                                {"type": "info", "f": "stop"}))

@@ -517,15 +517,14 @@ def etcd_test(opts: dict) -> dict:
                              "stats": chk.stats(),
                              "perf": chk.perf(),
                              "timeline": chk.timeline()}),
-        generator=gen.clients(
-            gen.time_limit(
-                opts.get("time_limit", 30),
+        # time-limit bounds client AND nemesis streams together; an
+        # unbounded nemesis cycle would keep the run alive forever
+        generator=gen.time_limit(
+            opts.get("time_limit", 30),
+            gen.clients(
                 gen.stagger(1.0 / opts.get("rate", 50),
-                            w["generator"])),
-            gen.cycle(gen.phases(gen.sleep(5),
-                                 {"type": "info", "f": "start"},
-                                 gen.sleep(5),
-                                 {"type": "info", "f": "stop"}))))
+                            w["generator"]),
+                jnemesis.start_stop_cycle(5.0))))
     return test
 
 

@@ -9,12 +9,20 @@ witnesses (G0, G1a, G1b, G1c, G-single, G2-item), plus non-cycle
 anomalies (aborted read, intermediate read, internal inconsistency,
 incompatible version orders, duplicate appends).
 
+This module is the HOST REFERENCE engine: plain-Python edge inference
+and scipy SCC, kept simple as the correctness baseline. Large
+histories dispatch (engine="auto") to the device engine —
+jepsen_tpu.tpu.elle_device interns txns/keys/values into int arrays,
+infers edges with numpy segment ops, and runs cycle detection through
+the batched label-propagation SCC kernel (jepsen_tpu.tpu.scc);
+differential tests pin the two engines to identical anomaly results.
+
 Pipeline here:
   1. collect committed/aborted/indeterminate txns from the history;
   2. per-key version orders: for list-append, the longest observed read
      is the spine and every read must be one of its prefixes;
-  3. vectorized edge inference over interned int arrays (numpy; the
-     same arrays stream to the device for the batched anomaly masks);
+  3. ww/wr/rw edge inference from external reads/writes against the
+     spine;
   4. exact SCC via scipy.sparse.csgraph (compiled Tarjan-equivalent:
      the graph step the reference runs on the JVM), cycle witness
      extraction host-side, classified by edge composition.

@@ -51,3 +51,16 @@ def workload(opts: dict | None = None) -> dict:
         "checker": independent.checker(chk.linearizable(
             {"model": models.cas_register(o.get("initial"))})),
     }
+
+
+def cas_op_mix(rng, n_values: int = 5):
+    """One random read/write/cas op dict per call — the canonical
+    cas-register op mix every register suite uses (etcd, zookeeper;
+    zookeeper.clj:74-76)."""
+    r = rng.random()
+    if r < 0.4:
+        return {"f": "read", "value": None}
+    if r < 0.7:
+        return {"f": "write", "value": rng.randrange(n_values)}
+    return {"f": "cas", "value": [rng.randrange(n_values),
+                                  rng.randrange(n_values)]}

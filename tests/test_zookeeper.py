@@ -1,0 +1,151 @@
+"""ZooKeeper suite tests: DB command emission via the dummy remote and
+a clusterless end-to-end run against a scripted zkCli (mirrors
+zookeeper/src/jepsen/zookeeper.clj)."""
+
+import re
+import threading
+
+from jepsen_tpu import checker as chk
+from jepsen_tpu import control, core, testing
+from jepsen_tpu import generator as gen
+from jepsen_tpu.checker import models
+from jepsen_tpu.control.core import Action, Result
+from jepsen_tpu.control.dummy import DummyRemote
+from jepsen_tpu.suites import zookeeper as zk
+
+
+def make_test(responder=None, nodes=("n1", "n2", "n3")):
+    remote = DummyRemote(responder)
+    t = testing.noop_test()
+    t.update(nodes=list(nodes), remote=remote,
+             sessions={n: remote.connect({"host": n}) for n in nodes})
+    return t
+
+
+def cmds(test, node):
+    return [a.cmd for a in test["sessions"][node].log
+            if isinstance(a, Action)]
+
+
+class TestDB:
+    def test_setup_commands(self):
+        test = make_test()
+        db = zk.ZkDB("3.4.13-2")
+        with control.with_session(test, "n2"):
+            db.setup(test, "n2")
+        acts = [a for a in test["sessions"]["n2"].log
+                if isinstance(a, Action)]
+        got = " ; ".join(a.cmd for a in acts)
+        assert "zookeeper=3.4.13-2" in got
+        assert "echo 1 > /etc/zookeeper/conf/myid" in got  # n2 -> id 1
+        cfg = next(a.stdin for a in acts
+                   if a.stdin and "zoo.cfg" in a.cmd)
+        assert "server.0=n1:2888:3888" in cfg
+        assert "server.2=n3:2888:3888" in cfg
+        assert "clientPort=2181" in cfg
+        assert "service zookeeper start" in got
+
+    def test_teardown_wipes_state(self):
+        test = make_test()
+        db = zk.ZkDB()
+        with control.with_session(test, "n1"):
+            db.teardown(test, "n1")
+        got = " ; ".join(cmds(test, "n1"))
+        assert "service zookeeper stop" in got
+        assert "/var/lib/zookeeper/version-*" in got
+
+
+class FakeZk:
+    """In-memory zk node with dataVersion, scripted through the dummy
+    remote's responder (commands arrive as one zkCli argv string)."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.value = None
+        self.version = -1
+
+    def responder(self, node, action):
+        cmd = action.cmd
+        if "zkCli.sh" not in cmd:
+            return None
+        m = re.search(r"zkCli\.sh -server \S+ (.+)$", cmd)
+        args = m.group(1).replace("'", "").split()
+        with self.lock:
+            if args[0] == "get":
+                if self.value is None:
+                    return Result(exit=1, out="",
+                                  err="NoNode for /jepsen", cmd=cmd)
+                return Result(
+                    exit=0, err="",
+                    out=f"{self.value}\ndataVersion = {self.version}\n",
+                    cmd=cmd)
+            if args[0] == "create":
+                if self.value is None:
+                    self.value = int(args[2])
+                    self.version = 0
+                    return Result(exit=0, out="Created", err="", cmd=cmd)
+                return Result(exit=1, out="", err="NodeExists", cmd=cmd)
+            if args[0] == "set":
+                if self.value is None:
+                    return Result(exit=1, out="", err="NoNode", cmd=cmd)
+                if len(args) >= 4:  # set path data version (3.4 cas)
+                    if int(args[3]) != self.version:
+                        return Result(
+                            exit=1, out="",
+                            err="KeeperErrorCode = BadVersion for "
+                                "/jepsen", cmd=cmd)
+                self.value = int(args[2])
+                self.version += 1
+                return Result(exit=0, out="", err="", cmd=cmd)
+        return Result(exit=1, out="", err=f"unknown {args}", cmd=cmd)
+
+
+class TestClient:
+    def test_ops_against_fake(self):
+        from jepsen_tpu.history import op
+
+        fake = FakeZk()
+        test = make_test(fake.responder, nodes=("n1",))
+        c = zk.ZkCasClient().open(test, "n1")
+        done = c.invoke(test, op(type="invoke", f="read", value=None))
+        assert done.type == "ok" and done.value == 0  # auto-created
+        done = c.invoke(test, op(type="invoke", f="write", value=3))
+        assert done.type == "ok"
+        done = c.invoke(test, op(type="invoke", f="cas", value=[3, 4]))
+        assert done.type == "ok"
+        done = c.invoke(test, op(type="invoke", f="cas", value=[9, 1]))
+        assert done.type == "fail"
+        done = c.invoke(test, op(type="invoke", f="read", value=None))
+        assert done.value == 4
+
+    def test_end_to_end_linearizable(self):
+        import random
+
+        fake = FakeZk()
+        test = make_test(fake.responder, nodes=("n1", "n2"))
+        rng = random.Random(4)
+
+        def one():
+            r = rng.random()
+            if r < 0.4:
+                return {"f": "read", "value": None}
+            if r < 0.7:
+                return {"f": "write", "value": rng.randrange(3)}
+            return {"f": "cas", "value": [rng.randrange(3),
+                                          rng.randrange(3)]}
+
+        test.update(concurrency=4, client=zk.ZkCasClient(),
+                    checker=chk.linearizable(
+                        {"model": models.cas_register(0)}),
+                    generator=gen.clients(gen.limit(120, one)))
+        test = core.run(test)
+        assert test["results"]["valid?"] is True, test["results"]
+
+
+class TestBundle:
+    def test_zk_test_shape(self):
+        t = zk.zk_test({"nodes": ["n1", "n2", "n3"], "concurrency": 6,
+                        "time_limit": 1, "seed": 2})
+        assert t["name"] == "zookeeper"
+        assert isinstance(t["db"], zk.ZkDB)
+        assert t["checker"] is not None
