@@ -122,10 +122,16 @@ class ZkCasClient(jclient.Client):
                                  timeout=10.0)
 
     def _read(self, test):
-        """(value, dataVersion); creates the node on first touch."""
+        """(value, dataVersion); creates the node on first touch. Only
+        a definite NoNode triggers the create — any other error (e.g.
+        a timeout mid-partition) propagates instead of burning two
+        more zkCli launches."""
         try:
             out = self._cli(test, "get", NODE_PATH)
-        except RemoteError:
+        except RemoteError as e:
+            err = f"{e.err or ''} {e.out or ''}".lower()
+            if "nonode" not in err:
+                raise
             self._cli(test, "create", NODE_PATH, "0")
             out = self._cli(test, "get", NODE_PATH)
         vm = _VALUE_RE.search(out)
@@ -164,7 +170,11 @@ class ZkCasClient(jclient.Client):
                         return op.copy(type="fail")  # lost the race
                     raise
             raise ValueError(f"unknown f {op.f!r}")
-        except Exception as e:  # noqa: BLE001 — indeterminate
+        except Exception as e:  # noqa: BLE001
+            if op.f == "read":
+                # reads are side-effect free: a failed read is a
+                # definite :fail, keeping the search space tight
+                return op.copy(type="fail", error=repr(e))
             return op.copy(type="info", error=repr(e))
 
 

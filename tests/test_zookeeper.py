@@ -121,18 +121,14 @@ class TestClient:
     def test_end_to_end_linearizable(self):
         import random
 
+        from jepsen_tpu.workloads import register as register_wl
+
         fake = FakeZk()
         test = make_test(fake.responder, nodes=("n1", "n2"))
         rng = random.Random(4)
 
         def one():
-            r = rng.random()
-            if r < 0.4:
-                return {"f": "read", "value": None}
-            if r < 0.7:
-                return {"f": "write", "value": rng.randrange(3)}
-            return {"f": "cas", "value": [rng.randrange(3),
-                                          rng.randrange(3)]}
+            return register_wl.cas_op_mix(rng, n_values=3)
 
         test.update(concurrency=4, client=zk.ZkCasClient(),
                     checker=chk.linearizable(
