@@ -498,34 +498,76 @@ def append_workload(opts: dict) -> dict:
 WORKLOADS = {"register": register_workload, "append": append_workload}
 
 
+def nemesis_for(opts: dict, db) -> dict:
+    """A composed nemesis package from --nemesis faults (the
+    reference's suites expose nemesis menus the same way,
+    combined.clj nemesis-package). Membership wires through
+    membership_package so its http_factory/seed/db sub-options apply;
+    an empty fault set gives the classic partitioner schedule. Never
+    mutates the caller's opts (a test-count sweep re-invokes the test
+    fn with the same dict, and a reused membership state machine would
+    carry the previous cluster's view)."""
+    from ..nemesis import combined
+
+    faults = set(opts.get("faults") or ())
+    if not faults:
+        return {"nemesis": jnemesis.partition_random_halves(),
+                "generator": jnemesis.start_stop_cycle(5.0),
+                "final_generator": None}
+    o = dict(opts)
+    o["membership"] = dict(opts.get("membership") or {})
+    o.update(db=db, interval=opts.get("nemesis_interval", 10))
+    pkgs = combined.nemesis_packages(
+        {**o, "faults": faults - {"membership"}})
+    if "membership" in faults:
+        mp = membership_package({**o, "faults": {"membership"}})
+        if mp is not None:
+            pkgs.append(mp)
+    return combined.compose_packages(pkgs)
+
+
 def etcd_test(opts: dict) -> dict:
     """Constructs an etcd test map from CLI options (the tutorial's
-    etcd-test / zookeeper.clj zk-test shape)."""
+    etcd-test / zookeeper.clj zk-test shape). opts["faults"] selects
+    the nemesis menu (partition/packet/kill/pause/clock/
+    file-corruption/membership); empty = classic partitioner."""
     name = opts.get("workload", "register")
     w = WORKLOADS[name](opts)
+    db = EtcdDB(opts.get("version", VERSION))
+    pkg = nemesis_for(opts, db)
     test = testing.noop_test()
     test.update(
         name=f"etcd-{name}",
         os=debian.os,
-        db=EtcdDB(opts.get("version", VERSION)),
+        db=db,
         ssh=opts["ssh"],
         nodes=opts["nodes"],
         concurrency=opts["concurrency"],
         client=w["client"],
-        nemesis=jnemesis.partition_random_halves(),
+        nemesis=pkg["nemesis"],
         checker=chk.compose({"workload": w["checker"],
                              "stats": chk.stats(),
                              "perf": chk.perf(),
                              "timeline": chk.timeline()}),
-        # time-limit bounds client AND nemesis streams together; an
-        # unbounded nemesis cycle would keep the run alive forever
-        generator=gen.time_limit(
-            opts.get("time_limit", 30),
-            gen.clients(
-                gen.stagger(1.0 / opts.get("rate", 50),
-                            w["generator"]),
-                jnemesis.start_stop_cycle(5.0))))
+        generator=_suite_generator(opts, w["generator"], pkg))
     return test
+
+
+def _suite_generator(opts, client_gen, pkg):
+    """time-limit bounds client AND nemesis streams together (an
+    unbounded nemesis cycle would keep the run alive forever); the
+    package's final generator runs AFTER the limit so faults heal
+    before teardown (combined.clj final-generator)."""
+    client_part = gen.stagger(1.0 / opts.get("rate", 50), client_gen)
+    nemesis_gen = pkg.get("generator")
+    main = gen.time_limit(
+        opts.get("time_limit", 30),
+        gen.clients(client_part, nemesis_gen)
+        if nemesis_gen is not None else gen.clients(client_part))
+    final = pkg.get("final_generator")
+    if final:
+        return gen.phases(main, gen.nemesis(final))
+    return main
 
 
 def _workload_opt(p):
@@ -534,13 +576,26 @@ def _workload_opt(p):
     p.add_argument("--version", default=VERSION,
                    help="etcd version tag to install.")
     p.add_argument("--rate", type=float, default=50)
+    p.add_argument("--nemesis", dest="faults", default=None,
+                   help="Comma-separated faults: partition,packet,"
+                        "kill,pause,clock,file-corruption,membership. "
+                        "Default: the classic partitioner schedule.")
     return p
+
+
+def _opt_fn(options):
+    opts = cli.test_opt_fn(options)
+    if getattr(options, "faults", None):
+        opts["faults"] = [f.strip()
+                          for f in options.faults.split(",") if f.strip()]
+    return opts
 
 
 def main(argv=None) -> None:
     commands = {}
     commands.update(cli.single_test_cmd(etcd_test,
-                                        parser_fn=_workload_opt))
+                                        parser_fn=_workload_opt,
+                                        opt_fn=_opt_fn))
     commands.update(cli.serve_cmd())
     cli.run_cli(commands, argv)
 

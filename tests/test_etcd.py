@@ -255,3 +255,33 @@ def test_register_end_to_end_catches_lost_writes():
         "register",
         etcd.EtcdRegisterClient(lambda n: BrokenHttp(state)))
     assert t["results"]["valid?"] is False
+
+
+def test_nemesis_menu():
+    """--nemesis selects composed fault packages; empty keeps the
+    classic partitioner (reference suites' nemesis menus)."""
+    from jepsen_tpu.nemesis.core import Partitioner
+    from jepsen_tpu.nemesis.membership import MembershipNemesis
+
+    base = {"nodes": ["n1", "n2", "n3"], "concurrency": 3, "ssh": {}}
+    t = etcd.etcd_test(dict(base))
+    assert isinstance(t["nemesis"], Partitioner)
+
+    t = etcd.etcd_test(dict(base, faults=["partition", "kill"]))
+    fs = t["nemesis"].fs()
+    assert "kill" in fs, fs
+    assert {"start-partition", "stop-partition"} & fs, fs
+    # kill leaves dead nodes: the final generator must heal
+    assert t["generator"] is not None
+
+    # membership sub-options flow through; caller's dict not mutated
+    mopts = {"seed": 3}
+    t = etcd.etcd_test(dict(base, faults=["membership"],
+                            membership=mopts))
+    assert "state" not in mopts  # no mutation of caller opts
+    nem = t["nemesis"]
+    # membership ops must route somewhere in the composed nemesis
+    assert {"add-member", "remove-member"} <= nem.fs(), nem.fs()
+    subs = [n for _spec, n in getattr(nem, "pairs", [])]
+    assert (isinstance(nem, MembershipNemesis)
+            or any(isinstance(x, MembershipNemesis) for x in subs)), nem
