@@ -560,20 +560,30 @@ def check_rw_register(hist, opts: dict | None = None) -> dict:
                 w = writer.get((k, _freeze(nv)))
                 if w is not None and w.i != t.i and w.type == h.OK:
                     edges.append((t.i, w.i, RW))
-    edges.extend(_order_edges([t for t in txns if t.type == h.OK]))
+    committed = [t for t in txns if t.type == h.OK]
 
     engine = (opts or {}).get("engine", "auto")
     if engine == "device" or (engine == "auto"
                               and len(hist) >= _DEVICE_MIN_OPS):
         # route cycle detection through the batched SCC kernel: one
         # full-graph pass proves clean histories, graded subsets run
-        # only when cycles exist (same dispatch as list-append)
+        # only when cycles exist (same dispatch as list-append). Order
+        # edges stay arrays end to end — they dominate the edge count,
+        # and tuple round-trips cost more than the SCC itself.
         from . import elle_device
 
-        e = np.asarray(edges, dtype=np.int64).reshape(-1, 3)
+        e = (np.asarray(edges, dtype=np.int64).reshape(-1, 3)
+             if edges else np.empty((0, 3), dtype=np.int64))
+        o_src, o_dst, o_ty = order_edge_arrays(committed)
+        src = np.concatenate([e[:, 0], o_src])
+        dst = np.concatenate([e[:, 1], o_dst])
+        ty = np.concatenate([e[:, 2], o_ty])
+        n_edges = int(len(src))
         cyc = elle_device.cycle_anomalies_arrays(
-            len(txns), e[:, 0], e[:, 1], e[:, 2], txns)
+            len(txns), src, dst, ty, txns)
     else:
+        edges.extend(_order_edges(committed))
+        n_edges = len(edges)
         cyc = cycle_anomalies(len(txns), edges, txns)
     for name, ws in cyc.items():
         anomalies[name] = ws
@@ -581,7 +591,7 @@ def check_rw_register(hist, opts: dict | None = None) -> dict:
         "valid?": not anomalies,
         "anomaly-types": sorted(anomalies.keys()),
         "anomalies": {k: v[:8] for k, v in anomalies.items()},
-        "edge-count": len(edges),
+        "edge-count": n_edges,
         "txn-count": len(txns),
     }
 
