@@ -25,7 +25,30 @@ CLIENTS = {
     "long-fork": lambda: testing.TxnClient(),
     "append": lambda: testing.TxnClient(),
     "wr": lambda: testing.TxnClient(),
+    "kafka": lambda: testing.KafkaClient(),
+    "causal": lambda: testing.CausalClient(),
+    "causal-reverse": lambda: testing.PerKeySetClient(),
+    "adya-g2": lambda: testing.G2Client(),
 }
+
+
+def _workload_opts(name: str, opts: dict) -> dict:
+    """Per-workload option scoping: only the knobs each workload
+    actually reads, so a CLI default can't silently reshape unrelated
+    workloads (e.g. long-fork's read-group size or adya's key count)."""
+    ops = opts.get("ops", 500)
+    wopts = {"ops": ops}
+    if name == "register":
+        # all threads share one key group; keys rotate sequentially
+        wopts.update({"group-size": opts["concurrency"],
+                      "ops_per_key": ops // 8 or 1})
+    elif name == "causal-reverse":
+        wopts.update({"per-key-limit": ops // 4 or 1})
+    return wopts
+
+
+# workloads whose concurrent generator uses fixed thread pairs
+_PAIRED = {"adya-g2", "causal-reverse"}
 
 
 def make_test(opts: dict) -> dict:
@@ -33,11 +56,13 @@ def make_test(opts: dict) -> dict:
     if name not in workloads.REGISTRY:
         raise SystemExit(f"unknown workload {name!r}; "
                          + cli.one_of(workloads.REGISTRY))
-    w = workloads.REGISTRY[name](
-        {"ops": opts.get("ops", 500),
-         "ops_per_key": opts.get("ops", 500) // 8 or 1,
-         # thread groups must divide concurrency (independent.clj)
-         "group_size": opts["concurrency"]})
+    w = workloads.REGISTRY[name](_workload_opts(name, opts))
+    if name in _PAIRED and opts["concurrency"] % 2:
+        # pair-based generators need an even thread count; park the
+        # last thread instead of failing the divisibility assert
+        usable = set(range(opts["concurrency"] - 1))
+        w = dict(w)
+        w["generator"] = gen.on_threads(usable, w["generator"])
     test = testing.noop_test()
     test.update(
         name=f"{name}-demo",

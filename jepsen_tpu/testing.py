@@ -347,3 +347,156 @@ class TxnClient(jclient.Client):
                 else:
                     raise ValueError(f"unknown mop {f!r}")
         return op.copy(type="ok", value=out)
+
+
+class CausalClient(jclient.Client):
+    """Single-site causal register per key: positions increase
+    monotonically, links chain per key; lose_write makes later reads
+    stale (the anomaly the causal checker catches). Mirrors the
+    reference's in-memory fixtures in jepsen.tests (tests.clj:26-66
+    pattern, applied to tests/causal.clj semantics)."""
+
+    def __init__(self, state=None, lose_write=False):
+        self.state = state if state is not None else {
+            "lock": threading.Lock(), "regs": {}, "pos": 0}
+        self.lose_write = lose_write
+
+    def open(self, test, node):
+        return CausalClient(self.state, self.lose_write)
+
+    def invoke(self, test, o):
+        from . import independent
+
+        k = independent.key_(o.value)
+        v = independent.value_(o.value)
+        with self.state["lock"]:
+            reg = self.state["regs"].setdefault(
+                k, {"value": 0, "counter": 0, "last": "init"})
+            self.state["pos"] += 1
+            pos = self.state["pos"]
+            link = reg["last"]
+            reg["last"] = pos
+            if o.f == "write":
+                if not (self.lose_write and v == 1):
+                    reg["value"] = v
+                reg["counter"] += 1
+                out = v
+            else:
+                out = reg["value"]
+            return o.copy(type="ok",
+                          value=independent.ktuple(k, out),
+                          position=pos,
+                          link="init" if o.f == "read-init" else link)
+
+
+class PerKeySetClient(jclient.Client):
+    """Blind writes into a per-key list; reads return it (the
+    causal-reverse workload's client shape). hide_first drops the
+    oldest acked write from later reads — the T2-without-T1 anomaly."""
+
+    def __init__(self, state=None, hide_first=False):
+        self.state = state if state is not None else {
+            "lock": threading.Lock(), "sets": {}}
+        self.hide_first = hide_first
+
+    def open(self, test, node):
+        return PerKeySetClient(self.state, self.hide_first)
+
+    def invoke(self, test, o):
+        from . import independent
+
+        k = independent.key_(o.value)
+        v = independent.value_(o.value)
+        with self.state["lock"]:
+            s = self.state["sets"].setdefault(k, [])
+            if o.f == "write":
+                s.append(v)
+                return o.copy(type="ok")
+            vals = list(s)
+            if self.hide_first and len(vals) > 2:
+                vals = vals[1:]
+            return o.copy(type="ok",
+                          value=independent.ktuple(k, vals))
+
+
+class G2Client(jclient.Client):
+    """Predicate-read-then-insert: under the lock at most one insert
+    per key commits (serializable); broken=True lets both commit — the
+    adya G2 anomaly."""
+
+    def __init__(self, state=None, broken=False):
+        self.state = state if state is not None else {
+            "lock": threading.Lock(), "rows": {}}
+        self.broken = broken
+
+    def open(self, test, node):
+        return G2Client(self.state, self.broken)
+
+    def invoke(self, test, o):
+        from . import independent
+
+        k = independent.key_(o.value)
+        with self.state["lock"]:
+            if self.state["rows"].get(k) and not self.broken:
+                return o.copy(type="fail")
+            self.state["rows"].setdefault(k, []).append(
+                independent.value_(o.value))
+            return o.copy(type="ok")
+
+
+class KafkaState:
+    """Shared in-memory partitioned log with per-(client, key)
+    consumer positions."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.logs: dict = {}
+
+    def append(self, k, v) -> int:
+        with self.lock:
+            self.logs.setdefault(k, []).append(v)
+            return len(self.logs[k]) - 1
+
+
+class KafkaClient(jclient.Client):
+    """Drives the kafka workload's send/poll/txn + subscribe/assign op
+    encoding against KafkaState; lose_offset makes one committed send
+    invisible to every consumer (a lost write)."""
+
+    def __init__(self, state=None, lose_offset=None):
+        self.state = state if state is not None else KafkaState()
+        self.lose_offset = lose_offset  # (key, offset) to hide
+        self.positions: dict = {}
+
+    def open(self, test, node):
+        c = KafkaClient(self.state, self.lose_offset)
+        return c
+
+    def invoke(self, test, o):
+        if o.f in ("subscribe", "assign"):
+            for k in o.value or []:
+                self.positions.setdefault(k, 0)
+            return o.copy(type="ok")
+        done = []
+        for m in o.value:
+            if m[0] == "send":
+                _f, k, v = m
+                off = self.state.append(k, v)
+                done.append(["send", k, [off, v]])
+            else:
+                reads: dict = {}
+                with self.state.lock:
+                    logs = {k: list(vs)
+                            for k, vs in self.state.logs.items()}
+                for k, log in logs.items():
+                    pos = self.positions.get(k, 0)
+                    pairs = []
+                    for i in range(pos, len(log)):
+                        if self.lose_offset == (k, i):
+                            continue
+                        pairs.append([i, log[i]])
+                    if pairs:
+                        reads[k] = pairs
+                    self.positions[k] = len(log)
+                done.append(["poll", reads])
+        return o.copy(type="ok", value=done)

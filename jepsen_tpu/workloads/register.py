@@ -42,7 +42,8 @@ def key_gen(k, ops_per_key=100, seed=None):
 def workload(opts: dict | None = None) -> dict:
     o = dict(opts or {})
     keys = o.get("keys", list(range(8)))
-    n_group = o.get("group_size", o.get("concurrency_per_key", 5))
+    n_group = o.get("group-size", o.get(
+        "group_size", o.get("concurrency_per_key", 5)))
     ops_per_key = o.get("ops_per_key", 100)
     seed = o.get("seed")
     return {

@@ -3,9 +3,6 @@ semantics on literal histories plus clusterless end-to-end runs with
 correct and broken in-memory clients (mirror
 jepsen/src/jepsen/tests/causal.clj, causal_reverse.clj, adya.clj)."""
 
-import threading
-
-from jepsen_tpu import client as jclient
 from jepsen_tpu import core, independent, testing
 from jepsen_tpu import generator as gen
 from jepsen_tpu.history import History, op
@@ -57,38 +54,7 @@ class TestCausalModel:
         assert "init value" in res["error"]
 
 
-class CausalClient(jclient.Client):
-    """Single-site causal register per key: positions increase, links
-    chain; optionally loses a write (making later reads stale)."""
-
-    def __init__(self, state=None, lose_write=False):
-        self.state = state if state is not None else {
-            "lock": threading.Lock(), "regs": {}, "pos": 0}
-        self.lose_write = lose_write
-
-    def open(self, test, node):
-        return CausalClient(self.state, self.lose_write)
-
-    def invoke(self, test, o):
-        k, v = independent.key_(o.value), independent.value_(o.value)
-        with self.state["lock"]:
-            reg = self.state["regs"].setdefault(
-                k, {"value": 0, "counter": 0, "last": "init"})
-            self.state["pos"] += 1
-            pos = self.state["pos"]
-            link = reg["last"]
-            reg["last"] = pos
-            if o.f == "write":
-                if not (self.lose_write and v == 1):
-                    reg["value"] = v
-                reg["counter"] += 1
-                out = v
-            else:
-                out = reg["value"]
-            return o.copy(type="ok",
-                          value=independent.ktuple(k, out),
-                          position=pos,
-                          link="init" if o.f == "read-init" else link)
+CausalClient = testing.CausalClient  # promoted to the library
 
 
 class TestCausalEndToEnd:
@@ -142,30 +108,7 @@ class TestCausalReverse:
         assert res["valid?"] is True, res
 
 
-class SetPerKeyClient(jclient.Client):
-    """Blind writes into a per-key set; reads return it (optionally
-    hiding an early write from later reads)."""
-
-    def __init__(self, state=None, hide_first=False):
-        self.state = state if state is not None else {
-            "lock": threading.Lock(), "sets": {}}
-        self.hide_first = hide_first
-
-    def open(self, test, node):
-        return SetPerKeyClient(self.state, self.hide_first)
-
-    def invoke(self, test, o):
-        k, v = independent.key_(o.value), independent.value_(o.value)
-        with self.state["lock"]:
-            s = self.state["sets"].setdefault(k, [])
-            if o.f == "write":
-                s.append(v)
-                return o.copy(type="ok")
-            vals = list(s)
-            if self.hide_first and len(vals) > 2:
-                vals = vals[1:]  # drop the oldest acked write
-            return o.copy(type="ok",
-                          value=independent.ktuple(k, vals))
+SetPerKeyClient = testing.PerKeySetClient  # promoted to the library
 
 
 class TestCausalReverseEndToEnd:
@@ -187,28 +130,7 @@ class TestCausalReverseEndToEnd:
         assert t["results"]["valid?"] is False
 
 
-class G2Client(jclient.Client):
-    """Predicate-read-then-insert client: under the lock at most one
-    insert per key succeeds (serializable); broken mode lets both
-    commit (the G2 anomaly)."""
-
-    def __init__(self, state=None, broken=False):
-        self.state = state if state is not None else {
-            "lock": threading.Lock(), "rows": {}}
-        self.broken = broken
-
-    def open(self, test, node):
-        return G2Client(self.state, self.broken)
-
-    def invoke(self, test, o):
-        k = independent.key_(o.value)
-        with self.state["lock"]:
-            existing = self.state["rows"].get(k)
-            if existing and not self.broken:
-                return o.copy(type="fail")
-            self.state["rows"].setdefault(k, []).append(
-                independent.value_(o.value))
-            return o.copy(type="ok")
+G2Client = testing.G2Client  # promoted to the library
 
 
 class TestAdyaG2:
