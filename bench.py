@@ -212,10 +212,28 @@ def bench_headline(n_events):
     }
 
 
+def _enable_compile_cache():
+    """Persistent XLA compilation cache: repeat bench runs skip the
+    ~35s one-time kernel compiles."""
+    try:
+        import jax
+
+        cache_dir = os.environ.get(
+            "JAX_COMPILATION_CACHE_DIR",
+            os.path.expanduser("~/.cache/jepsen_tpu/xla"))
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          0.5)
+    except Exception as e:  # noqa: BLE001 — cache is best-effort
+        _log(f"compilation cache unavailable: {e!r}")
+
+
 def main():
     from jepsen_tpu.tpu import dist
 
     dist.ensure_initialized()  # before the first JAX computation
+    _enable_compile_cache()
     n_events = int(os.environ.get("BENCH_OPS", "1000000"))
     small = n_events < 1_000_000
     lines = []
