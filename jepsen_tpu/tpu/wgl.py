@@ -630,14 +630,112 @@ def segment_cuts(enc: Encoded, target_len: int = 2048,
     return cuts
 
 
+class _SegmentCheckpoint:
+    """CRC-framed (k, s) -> mask log keyed by a fingerprint of the
+    history + transition tables + cut layout, so a checkpoint written
+    for different data OR a different model never poisons a check."""
+
+    def __init__(self, path, enc: Encoded, cuts):
+        import zlib as _z
+        from pathlib import Path as _P
+
+        self.path = _P(path)
+        h = _z.crc32(enc.inv_t.tobytes())
+        h = _z.crc32(enc.ret_t.tobytes(), h)
+        h = _z.crc32(enc.trans.tobytes(), h)  # model semantics
+        h = _z.crc32(np.asarray(cuts, dtype=np.int64).tobytes(), h)
+        self.fingerprint = int(h)
+        self._known: set = set()
+        self._reset_needed = False
+        self._opened = False
+
+    def load(self) -> dict:
+        import json as _json
+
+        from ..store import format as sformat
+
+        out: dict = {}
+        if not self.path.exists():
+            return out
+        try:
+            for payload, _end in sformat._scan_path(self.path):
+                d = _json.loads(payload)
+                if d.get("fp") != self.fingerprint:
+                    # different history/model/cuts: restart the file
+                    # on the next write, or mixed-fingerprint records
+                    # would poison every later load
+                    self._reset_needed = True
+                    self._known = set()
+                    return {}
+                out[(d["k"], d["s"])] = d["m"]
+        except (OSError, ValueError):
+            self._reset_needed = True
+            return {}
+        self._known = set(out)
+        return out
+
+    def _prepare(self):
+        """First write: restart a stale/corrupt file, or truncate a
+        torn tail so appends stay reachable (the HistoryWriter reopen
+        rule — appending after a torn record hides everything later)."""
+        from ..store import format as sformat
+
+        self.path.parent.mkdir(parents=True, exist_ok=True)
+        if self._reset_needed or not self.path.exists():
+            with open(self.path, "wb") as f:
+                f.write(sformat.MAGIC)
+            self._reset_needed = False
+        else:
+            end = sformat._valid_prefix_end(self.path)
+            if end == 0:
+                with open(self.path, "wb") as f:
+                    f.write(sformat.MAGIC)
+            elif end < self.path.stat().st_size:
+                with open(self.path, "r+b") as f:
+                    f.truncate(end)
+        self._opened = True
+
+    def save_one(self, k: int, s: int, mask: int) -> None:
+        import json as _json
+        import struct as _struct
+        import zlib as _z
+
+        if (k, s) in self._known:
+            return
+        if not self._opened:
+            self._prepare()
+        with open(self.path, "ab") as f:
+            payload = _json.dumps(
+                {"fp": self.fingerprint, "k": k, "s": s,
+                 "m": int(mask)}).encode()
+            f.write(_struct.pack("<II", len(payload),
+                                 _z.crc32(payload)))
+            f.write(payload)
+        self._known.add((k, s))
+
+    def save(self, resolved: dict) -> None:
+        for (k, s), m in resolved.items():
+            if m is not None:
+                self.save_one(k, s, m)
+
+
 def check_segmented(enc: Encoded, target_len: int = 2048, W: int = 24,
                     F: int = 48, witness: bool = False,
-                    prefix_screen: int = 96) -> dict | None:
+                    prefix_screen: int = 96,
+                    checkpoint_path=None) -> dict | None:
     """Checks one long history by cutting it into segments, computing
     per-(segment, start-state) final-state reachability in ONE batched
     device launch, and composing reachability masks across segments.
     Returns None when the history doesn't segment usefully (caller uses
     the plain kernel).
+
+    checkpoint_path: persists every resolved (segment, start-state)
+    reachability mask to a CRC-framed log as it lands, and reloads it
+    on entry — a crashed or interrupted long check resumes without
+    re-searching finished segments (SURVEY §5: long-running checker
+    jobs checkpoint search state; the history itself checkpoints the
+    same way in the store). Entries are keyed by history fingerprint
+    so a stale checkpoint for different data is ignored.
 
     prefix_screen: before launching, each (segment, start-state) row is
     screened by a cheap host search over the segment's first
@@ -662,6 +760,10 @@ def check_segmented(enc: Encoded, target_len: int = 2048, W: int = 24,
     # UNKNOWN, resolve lazily on host ONLY if the composition actually
     # reaches that state (unknown rows are the hardest searches).
     resolved: dict[tuple[int, int], int | None] = {}
+    ckpt = (_SegmentCheckpoint(checkpoint_path, enc, cuts)
+            if checkpoint_path else None)
+    if ckpt is not None:
+        resolved.update(ckpt.load())
     rows: list[tuple[int, int]] = []
     if prefix_screen:
         for k in range(K):
@@ -675,12 +777,16 @@ def check_segmented(enc: Encoded, target_len: int = 2048, W: int = 24,
                 # in the would-be prefix: the exhaustive host search
                 # can branch exponentially there (crashes both forbid
                 # cuts and double the frontier per entry) — leave every
-                # state to the kernel instead of screening.
-                rows.extend((k, s) for s in range(S))
+                # state to the kernel instead of screening (minus
+                # checkpoint-restored entries).
+                rows.extend((k, s) for s in range(S)
+                            if resolved.get((k, s)) is None)
                 continue
             exact = pre_end == hi
             pre = segs[k] if exact else enc.segment(lo, pre_end)
             for s in range(S):
+                if resolved.get((k, s)) is not None:
+                    continue  # restored from the checkpoint
                 mask = search_host_reach(pre.with_init(s))
                 if exact:
                     resolved[(k, s)] = mask
@@ -689,7 +795,8 @@ def check_segmented(enc: Encoded, target_len: int = 2048, W: int = 24,
                 else:
                     rows.append((k, s))
     else:
-        rows = [(k, s) for k in range(K) for s in range(S)]
+        rows = [(k, s) for k in range(K) for s in range(S)
+                if resolved.get((k, s)) is None]
     if rows:
         # One packed copy per segment; rows share it via the kernel's
         # row->segment indirection.
@@ -699,6 +806,8 @@ def check_segmented(enc: Encoded, target_len: int = 2048, W: int = 24,
         unk = np.asarray(unk)[:len(rows)]
         for i, (k, s) in enumerate(rows):
             resolved[(k, s)] = None if unk[i] else int(out[i])
+    if ckpt is not None:
+        ckpt.save(resolved)
     reach = 1 << enc.init_state
     for k in range(K):
         nreach = 0
@@ -708,6 +817,8 @@ def check_segmented(enc: Encoded, target_len: int = 2048, W: int = 24,
                 if mask is None:
                     mask = search_host_reach(segs[k].with_init(s))
                     resolved[(k, s)] = mask
+                    if ckpt is not None:
+                        ckpt.save_one(k, s, mask)
                 nreach |= mask
         if nreach == 0:
             res: dict = {"valid?": False, "failed-segment": k,
