@@ -235,8 +235,17 @@ class Linearizable(Checker):
     def check(self, test, hist, opts=None):
         from ..tpu import wgl
 
+        ckpt_dir = None
+        if isinstance(test, dict) and test.get("checkpoint?") \
+                and test.get("store_dir"):
+            from pathlib import Path
+
+            # a DIRECTORY: each check derives a per-fingerprint file,
+            # so concurrent per-key/composed checkers never collide
+            ckpt_dir = Path(test["store_dir"]) / "checker-frontier"
         return self._trim(wgl.analysis(self.model, hist,
-                                       algorithm=self.algorithm))
+                                       algorithm=self.algorithm,
+                                       checkpoint_dir=ckpt_dir))
 
     def check_batch(self, test, hists, opts=None) -> list[dict]:
         from ..tpu import wgl

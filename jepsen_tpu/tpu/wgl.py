@@ -722,20 +722,26 @@ class _SegmentCheckpoint:
 def check_segmented(enc: Encoded, target_len: int = 2048, W: int = 24,
                     F: int = 48, witness: bool = False,
                     prefix_screen: int = 96,
-                    checkpoint_path=None) -> dict | None:
+                    checkpoint_path=None,
+                    checkpoint_dir=None) -> dict | None:
     """Checks one long history by cutting it into segments, computing
     per-(segment, start-state) final-state reachability in ONE batched
     device launch, and composing reachability masks across segments.
     Returns None when the history doesn't segment usefully (caller uses
     the plain kernel).
 
-    checkpoint_path: persists every resolved (segment, start-state)
+    checkpoint_path / checkpoint_dir: persists every resolved
+    (segment, start-state)
     reachability mask to a CRC-framed log as it lands, and reloads it
     on entry — a crashed or interrupted long check resumes without
     re-searching finished segments (SURVEY §5: long-running checker
     jobs checkpoint search state; the history itself checkpoints the
     same way in the store). Entries are keyed by history fingerprint
     so a stale checkpoint for different data is ignored.
+    checkpoint_path names one exact file (single-check usage);
+    checkpoint_dir derives a per-fingerprint filename, so concurrent
+    checkers (per-key independent checks, composed checkers) sharing a
+    store directory never fight over one file.
 
     prefix_screen: before launching, each (segment, start-state) row is
     screened by a cheap host search over the segment's first
@@ -760,8 +766,17 @@ def check_segmented(enc: Encoded, target_len: int = 2048, W: int = 24,
     # UNKNOWN, resolve lazily on host ONLY if the composition actually
     # reaches that state (unknown rows are the hardest searches).
     resolved: dict[tuple[int, int], int | None] = {}
-    ckpt = (_SegmentCheckpoint(checkpoint_path, enc, cuts)
-            if checkpoint_path else None)
+    ckpt = None
+    if checkpoint_path is not None:
+        ckpt = _SegmentCheckpoint(checkpoint_path, enc, cuts)
+    elif checkpoint_dir is not None:
+        probe = _SegmentCheckpoint("/dev/null", enc, cuts)
+        from pathlib import Path as _P
+
+        ckpt = _SegmentCheckpoint(
+            _P(checkpoint_dir)
+            / f"frontier-{probe.fingerprint & 0xffffffff:08x}.jlog",
+            enc, cuts)
     if ckpt is not None:
         resolved.update(ckpt.load())
     rows: list[tuple[int, int]] = []
@@ -841,7 +856,8 @@ def check_segmented(enc: Encoded, target_len: int = 2048, W: int = 24,
 # ---------------------------------------------------------------------------
 
 def analysis(model, hist, algorithm: str = "tpu", W: int | None = None,
-             F: int | None = None) -> dict:
+             F: int | None = None, checkpoint_path=None,
+             checkpoint_dir=None) -> dict:
     """Checks a single history against a model.
 
     algorithm: 'tpu'  — device kernel, host fallback on UNKNOWN
@@ -879,6 +895,10 @@ def analysis(model, hist, algorithm: str = "tpu", W: int | None = None,
             seg_kw["W"] = W
         if F is not None:
             seg_kw["F"] = F
+        if checkpoint_path is not None:
+            seg_kw["checkpoint_path"] = checkpoint_path
+        if checkpoint_dir is not None:
+            seg_kw["checkpoint_dir"] = checkpoint_dir
         seg = check_segmented(enc, witness=True, **seg_kw)
         if seg is not None:
             seg["analyzer"] = "tpu-segmented"

@@ -491,3 +491,23 @@ def test_segmented_checkpoint_stale_file_resets(tmp_path):
     # the file was restarted for h2: its checkpoint now loads fully
     c = wgl._SegmentCheckpoint(ck, e2, wgl.segment_cuts(e2, 256))
     assert len(c.load()) > 0
+
+
+def test_linearizable_checker_checkpoints_via_test_map(tmp_path):
+    from jepsen_tpu import checker as chk
+    from jepsen_tpu.tpu import synth
+
+    hist = synth.register_history(6000, n_procs=4, seed=38)
+    c = chk.linearizable({"model": model.cas_register()})
+    test = {"checkpoint?": True, "store_dir": str(tmp_path)}
+    out = c.check(test, hist)
+    assert out["valid?"] is True
+    files = list((tmp_path / "checker-frontier").glob("frontier-*.jlog"))
+    assert files, "per-fingerprint checkpoint file expected"
+    # a second, different-keyed check gets its OWN file (no collision)
+    hist2 = synth.register_history(6000, n_procs=4, seed=39)
+    out2 = c.check(test, hist2)
+    assert out2["valid?"] is True
+    files2 = list((tmp_path / "checker-frontier").glob(
+        "frontier-*.jlog"))
+    assert len(files2) == 2, files2
