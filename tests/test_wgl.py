@@ -399,7 +399,7 @@ def test_segmented_checkpoint_resume(tmp_path):
     (SURVEY §5 checker-state checkpointing)."""
     from jepsen_tpu.tpu import synth
 
-    hist = synth.register_history(4000, n_procs=4, seed=31)
+    hist = synth.register_history(1500, n_procs=4, seed=31)
     enc = encode(model.cas_register(), hist)
     ck = tmp_path / "frontier.jlog"
     r1 = wgl.check_segmented(enc, target_len=256, checkpoint_path=ck)
@@ -426,8 +426,8 @@ def test_segmented_checkpoint_resume(tmp_path):
 def test_segmented_checkpoint_ignores_stale(tmp_path):
     from jepsen_tpu.tpu import synth
 
-    h1 = synth.register_history(4000, n_procs=4, seed=32)
-    h2 = synth.register_history(4000, n_procs=4, seed=33)
+    h1 = synth.register_history(1500, n_procs=4, seed=32)
+    h2 = synth.register_history(1500, n_procs=4, seed=33)
     ck = tmp_path / "frontier.jlog"
     e1 = encode(model.cas_register(), h1)
     e2 = encode(model.cas_register(), h2)
@@ -442,7 +442,7 @@ def test_segmented_checkpoint_model_mismatch_ignored(tmp_path):
     for one model never feeds another (round-3 review finding)."""
     from jepsen_tpu.tpu import synth
 
-    hist = synth.register_history(4000, n_procs=4, seed=34)
+    hist = synth.register_history(1500, n_procs=4, seed=34)
     ck = tmp_path / "frontier.jlog"
     e1 = encode(model.cas_register(), hist)
     wgl.check_segmented(e1, target_len=256, checkpoint_path=ck)
@@ -460,7 +460,7 @@ def test_segmented_checkpoint_survives_torn_tail(tmp_path):
     before the next write (round-3 review finding)."""
     from jepsen_tpu.tpu import synth
 
-    hist = synth.register_history(4000, n_procs=4, seed=35)
+    hist = synth.register_history(1500, n_procs=4, seed=35)
     enc = encode(model.cas_register(), hist)
     ck = tmp_path / "frontier.jlog"
     wgl.check_segmented(enc, target_len=256, checkpoint_path=ck)
@@ -481,8 +481,8 @@ def test_segmented_checkpoint_survives_torn_tail(tmp_path):
 def test_segmented_checkpoint_stale_file_resets(tmp_path):
     from jepsen_tpu.tpu import synth
 
-    h1 = synth.register_history(4000, n_procs=4, seed=36)
-    h2 = synth.register_history(4000, n_procs=4, seed=37)
+    h1 = synth.register_history(1500, n_procs=4, seed=36)
+    h2 = synth.register_history(1500, n_procs=4, seed=37)
     ck = tmp_path / "frontier.jlog"
     e1 = encode(model.cas_register(), h1)
     e2 = encode(model.cas_register(), h2)
