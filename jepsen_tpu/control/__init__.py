@@ -58,12 +58,14 @@ def remote_for(test: dict) -> Remote:
 
 
 def _default_ssh() -> Remote:
-    # ssh wrapped for auto-reconnect + retry of transport failures,
-    # like the reference's default sshj-in-retry stack
-    # (control.clj with-remote + control/retry.clj)
+    # ssh wrapped for sudo-aware transfers, then auto-reconnect +
+    # retry of transport failures, like the reference's default
+    # scp-in-retry stack (control.clj with-remote + control/retry.clj
+    # + control/scp.clj)
     from .retry import RetryingRemote
+    from .scp import ScpRemote
     from .ssh import SshRemote
-    return RetryingRemote(SshRemote())
+    return RetryingRemote(ScpRemote(SshRemote()))
 
 
 def session(test: dict, node) -> Session:

@@ -266,3 +266,175 @@ class TestDockerRegressions:
         r = ScriptedRunner(lambda argv, stdin: Result(0, DOCKER_PS, "", ""))
         with pytest.raises(RemoteError):
             resolve_container_id("localhost:2379", r)
+
+
+class TestScp:
+    """Sudo-aware transfer wrapper (control/scp.clj:82-146)."""
+
+    def _session(self, responder=None):
+        from jepsen_tpu.control.dummy import DummyRemote
+        from jepsen_tpu.control.scp import ScpRemote
+
+        remote = ScpRemote(DummyRemote(responder))
+        sess = remote.connect({"host": "n1", "username": "admin"})
+        return sess, sess.base
+
+    def test_plain_upload_delegates(self):
+        sess, base = self._session()
+        sess.upload("/local/f", "/remote/f")
+        assert base.log == [("upload", "/local/f", "/remote/f")]
+
+    def test_matching_sudo_delegates(self):
+        from jepsen_tpu import control
+
+        sess, base = self._session()
+        with control.su("admin"):
+            sess.upload("/local/f", "/remote/f")
+        assert base.log == [("upload", "/local/f", "/remote/f")]
+
+    def test_sudo_upload_does_tmpfile_dance(self):
+        from jepsen_tpu import control
+        from jepsen_tpu.control.core import Action
+        from jepsen_tpu.control.scp import TMP_DIR
+
+        sess, base = self._session()
+        with control.su():
+            sess.upload("/local/f", "/etc/secret")
+        uploads = [e for e in base.log if isinstance(e, tuple)]
+        assert len(uploads) == 1
+        (_, src, tmp) = uploads[0]
+        assert src == "/local/f" and tmp.startswith(TMP_DIR + "/")
+        assert tmp.endswith("/f")  # basename preserved under tmp subdir
+        cmds = [a.cmd for a in base.log if isinstance(a, Action)]
+        assert f"install -d -m 0777 {TMP_DIR}" in cmds
+        assert f"chown root {tmp}" in cmds
+        assert f"mv {tmp} /etc/secret" in cmds
+        # cleanup is best-effort
+        assert any(c.startswith(f"rm -rf {TMP_DIR}/") for c in cmds)
+        # privilege steps run as root
+        chown = next(a for a in base.log if isinstance(a, Action)
+                     and a.cmd.startswith("chown"))
+        assert chown.sudo == "root"
+
+    def test_sudo_download_readable_file_fetches_directly(self):
+        from jepsen_tpu import control
+
+        sess, base = self._session()  # head succeeds by default
+        with control.su():
+            sess.download("/var/log/syslog", "/tmp/out")
+        assert ("download", "/var/log/syslog", "/tmp/out") in base.log
+
+    def test_sudo_download_unreadable_file_copies_first(self):
+        from jepsen_tpu import control
+        from jepsen_tpu.control.core import Action, Result
+        from jepsen_tpu.control.scp import TMP_DIR
+
+        def responder(node, action):
+            if action.cmd.startswith("head"):
+                return Result(exit=1, out="", err="Permission denied",
+                              cmd=action.cmd)
+            return None
+
+        sess, base = self._session(responder)
+        with control.su():
+            sess.download("/root/secret", "/tmp/out")
+        cmds = [a.cmd for a in base.log if isinstance(a, Action)]
+        assert any(c.startswith(f"cp /root/secret {TMP_DIR}/")
+                   for c in cmds)
+        # never ln -L: chowning a hardlink would chown the source inode
+        assert not any(c.startswith("ln") for c in cmds)
+        assert any(c.startswith(f"chown admin {TMP_DIR}/") for c in cmds)
+        dl = next(e for e in base.log if isinstance(e, tuple)
+                  and e[0] == "download")
+        assert dl[1].startswith(TMP_DIR + "/") and dl[2] == "/tmp/out"
+
+    def test_multi_file_sudo_upload_preserves_basenames(self):
+        from jepsen_tpu import control
+        from jepsen_tpu.control.core import Action
+
+        sess, base = self._session()
+        with control.su():
+            sess.upload(["/l/a.conf", "/l/b.conf"], "/etc/app")
+        mvs = [a.cmd for a in base.log if isinstance(a, Action)
+               and a.cmd.startswith("mv")]
+        assert len(mvs) == 2
+        assert mvs[0].split()[1].endswith("/a.conf")
+        assert mvs[1].split()[1].endswith("/b.conf")
+        assert mvs[0].endswith(" /etc/app/a.conf")
+        assert mvs[1].endswith(" /etc/app/b.conf")
+
+    def test_default_stack_includes_scp_wrapper(self):
+        from jepsen_tpu.control import _default_ssh
+        from jepsen_tpu.control.retry import RetryingRemote
+        from jepsen_tpu.control.scp import ScpRemote
+        from jepsen_tpu.control.ssh import SshRemote
+
+        stack = _default_ssh()
+        assert isinstance(stack, RetryingRemote)
+        assert isinstance(stack.remote, ScpRemote)
+        assert isinstance(stack.remote.remote, SshRemote)
+
+    def test_tmp_dir_created_once_per_session(self):
+        from jepsen_tpu import control
+        from jepsen_tpu.control.core import Action
+
+        sess, base = self._session()
+        with control.su():
+            sess.upload("/a", "/x")
+            sess.upload("/b", "/y")
+        from jepsen_tpu.control.scp import TMP_DIR
+
+        mkdirs = [a for a in base.log if isinstance(a, Action)
+                  and a.cmd == f"install -d -m 0777 {TMP_DIR}"]
+        assert len(mkdirs) == 1  # the shared dir; subdirs are per-file
+
+    def test_hostile_basename_upload_restores_real_name_in_dir(self):
+        from jepsen_tpu import control
+        from jepsen_tpu.control.core import Action, Result
+
+        def responder(node, action):
+            if action.cmd.startswith("test -d"):
+                return Result(exit=0, out="", err="", cmd=action.cmd)
+            return None
+
+        sess, base = self._session(responder)
+        with control.su():
+            sess.upload("/l/my config (prod).yaml", "/etc/app")
+        up = next(e for e in base.log if isinstance(e, tuple))
+        assert up[2].endswith("/file")  # sanitized tmp name for scp
+        mv = next(a.cmd for a in base.log if isinstance(a, Action)
+                  and a.cmd.startswith("mv"))
+        assert mv.endswith(" '/etc/app/my config (prod).yaml'")
+
+    def test_hostile_basename_download_renames_locally(self, tmp_path):
+        from jepsen_tpu import control
+        from jepsen_tpu.control.core import Result
+
+        def responder(node, action):
+            if action.cmd.startswith("head"):
+                return Result(exit=1, out="", err="denied",
+                              cmd=action.cmd)
+            return None
+
+        from jepsen_tpu.control.dummy import DummyRemote
+        from jepsen_tpu.control.scp import ScpRemote
+
+        class WritingDummy(DummyRemote):
+            def connect(self, conn_spec):
+                sess = super().connect(conn_spec)
+                orig = sess.download
+
+                def download(remote_paths, local_path):
+                    orig(remote_paths, local_path)
+                    import os
+                    name = os.path.basename(str(remote_paths))
+                    (tmp_path / name).write_text("data")
+                sess.download = download
+                return sess
+
+        remote = ScpRemote(WritingDummy(responder))
+        sess = remote.connect({"host": "n1", "username": "admin"})
+        with control.su():
+            sess.download("/var/log/app log.1", str(tmp_path))
+        assert (tmp_path / "app log.1").read_text() == "data"
+        assert not (tmp_path / "file").exists()
