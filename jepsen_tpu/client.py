@@ -146,3 +146,18 @@ def timeout(timeout_or_fn, client: Client) -> Timeout:
     if callable(timeout_or_fn):
         return Timeout(timeout_or_fn, client)
     return Timeout(lambda _op: timeout_or_fn, client)
+
+
+def definite_http_failure(e: Exception) -> bool:
+    """True when an HTTP request certainly never executed — a refused
+    connection — so the op is a safe definite :fail. Timeouts, resets
+    and 5xx are indeterminate (:info): the server may have applied the
+    write before the reply was lost. Shared by the HTTP-driven suites
+    (the reference's suites each carry a with-errors macro making the
+    same split, e.g. consul/client.clj with-errors)."""
+    import urllib.error
+
+    if isinstance(e, urllib.error.URLError):
+        reason = getattr(e, "reason", None)
+        return isinstance(reason, ConnectionRefusedError)
+    return isinstance(e, ConnectionRefusedError)

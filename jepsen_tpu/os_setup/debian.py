@@ -36,12 +36,17 @@ def setup_hostfile() -> None:
 
 
 def time_since_last_update() -> int:
-    """Seconds since the last apt-get update (debian.clj:33-38)."""
-    now = int(control.exec_("date", "+%s"))
-    then = control.exec_("stat", "-c", "%Y",
-                         "/var/cache/apt/pkgcache.bin", Lit("||"),
-                         "echo", 0)
-    return now - int(then or 0)
+    """Seconds since the last apt-get update (debian.clj:33-38).
+    Unparseable output (e.g. the dummy remote's empty replies) reads
+    as stale, so the harmless apt-get update runs."""
+    try:
+        now = int(control.exec_("date", "+%s"))
+        then = control.exec_("stat", "-c", "%Y",
+                             "/var/cache/apt/pkgcache.bin", Lit("||"),
+                             "echo", 0)
+        return now - int(then or 0)
+    except ValueError:
+        return 10**9
 
 
 def update() -> None:

@@ -18,7 +18,6 @@ import base64
 import json
 import logging
 import random
-import urllib.error
 import urllib.request
 
 from .. import checker as chk
@@ -216,13 +215,7 @@ class EtcdHttp:
                          {"ID": member_id})
 
 
-def _definite(e: Exception) -> bool:
-    """True when the request certainly never executed (safe to :fail);
-    timeouts and other errors are indeterminate (:info)."""
-    if isinstance(e, urllib.error.URLError):
-        reason = getattr(e, "reason", None)
-        return isinstance(reason, ConnectionRefusedError)
-    return isinstance(e, ConnectionRefusedError)
+_definite = jclient.definite_http_failure
 
 
 class EtcdRegisterClient(jclient.Client):
@@ -583,11 +576,14 @@ def _workload_opt(p):
     return p
 
 
-def _opt_fn(options):
-    opts = cli.test_opt_fn(options)
-    if getattr(options, "faults", None):
+def _opt_fn(opts: dict) -> dict:
+    """single_test_cmd hands opt_fn the already-normalized opts dict
+    (calling test_opt_fn again here was a TypeError — the --nemesis
+    flag never worked from the real CLI)."""
+    if opts.get("faults"):
         opts["faults"] = [f.strip()
-                          for f in options.faults.split(",") if f.strip()]
+                          for f in opts["faults"].split(",")
+                          if f.strip()]
     return opts
 
 
