@@ -29,6 +29,7 @@ from .. import nemesis as jnemesis
 from .. import net, testing
 from ..checker import models
 from ..control import util as cu
+from ..core import primary
 from ..os_setup import debian
 
 logger = logging.getLogger(__name__)
@@ -131,11 +132,6 @@ def await_cluster_ready(http: ConsulHttp, n_nodes: int,
     util.await_fn(check, timeout_secs=timeout_secs,
                   log_message="waiting for consul catalog")
 
-
-
-def primary(test):
-    """Bootstrap node (the reference's jepsen/primary: first node)."""
-    return test["nodes"][0]
 
 
 class ConsulDB(jdb.DB):

@@ -1,0 +1,339 @@
+"""Postgres suite tests: DB command emission via the dummy remote, a
+scripted in-memory "postgres" speaking the suite's SQL shapes, and
+clusterless end-to-end append (elle) + bank runs (mirrors
+stolon/src/jepsen/stolon/{append,ledger,client}.clj)."""
+
+import re
+import threading
+
+import pytest
+
+from jepsen_tpu import control, core, testing
+from jepsen_tpu import generator as gen
+from jepsen_tpu.control.core import Action, RemoteError
+from jepsen_tpu.control.dummy import DummyRemote
+from jepsen_tpu.suites import postgres as pg
+
+
+def make_test(responder=None, nodes=("n1", "n2", "n3")):
+    remote = DummyRemote(responder)
+    t = testing.noop_test()
+    t.update(nodes=list(nodes), remote=remote,
+             sessions={n: remote.connect({"host": n}) for n in nodes})
+    return t
+
+
+def cmds(test, node):
+    return [a for a in test["sessions"][node].log
+            if isinstance(a, Action)]
+
+
+class TestDB:
+    def test_primary_gets_server_and_schema(self):
+        test = make_test(lambda node, a:
+                         "/etc/postgresql/15/main/pg_hba.conf"
+                         if a.cmd.startswith("psql") and
+                         "SHOW hba_file" in a.cmd else None)
+        db = pg.PostgresDB()
+        with control.with_session(test, "n1"):
+            db.setup(test, "n1")
+        acts = cmds(test, "n1")
+        got = " ; ".join(a.cmd for a in acts)
+        assert "postgresql" in got
+        assert "listen_addresses" in got
+        assert "pg_hba.conf" in got and "trust" in got
+        assert "CREATE TABLE txn0" in got
+        assert "CREATE TABLE accounts" in got
+        assert "CHECK (balance >= 0)" in got
+        assert "service postgresql restart" in got
+        # schema statements run as the postgres superuser
+        create = next(a for a in acts if "CREATE TABLE txn0" in a.cmd)
+        assert create.sudo == "postgres"
+
+    def test_secondaries_get_client_only(self):
+        test = make_test()
+        db = pg.PostgresDB()
+        with control.with_session(test, "n2"):
+            db.setup(test, "n2")
+        got = " ; ".join(a.cmd for a in cmds(test, "n2"))
+        assert "postgresql-client" in got
+        assert "CREATE TABLE" not in got
+
+    def test_teardown_drops_db(self):
+        test = make_test()
+        db = pg.PostgresDB()
+        with control.with_session(test, "n1"):
+            db.teardown(test, "n1")
+        got = " ; ".join(a.cmd for a in cmds(test, "n1"))
+        assert "DROP DATABASE IF EXISTS jepsen" in got
+        assert "service postgresql stop" in got
+
+
+class FakePostgres:
+    """In-memory store executing exactly the SQL shapes the suite
+    emits, one whole psql invocation at a time under a lock — i.e. a
+    perfectly serializable single-node 'postgres'."""
+
+    def __init__(self, accounts=8, balance=10):
+        self.lock = threading.Lock()
+        self.tables = {f"txn{i}": {} for i in range(pg.TABLE_COUNT)}
+        self.accounts = {i: balance for i in range(accounts)}
+        self.statements: list = []
+
+    # -- statement interpreters -----------------------------------------
+
+    def _read_mop(self, m):
+        t, k = m.group(2), int(m.group(3))
+        val = self.tables[t].get(k)
+        return f"m{m.group(1)}=" + ("~" if val is None else val)
+
+    def _append_mop(self, m):
+        t, k, v = m.group(1), int(m.group(2)), m.group(3)
+        cur = self.tables[t].get(k)
+        self.tables[t][k] = v if cur is None else f"{cur},{v}"
+        return None
+
+    def _bank_read(self, _m):
+        return "b=" + ",".join(f"{i}:{b}" for i, b in
+                               sorted(self.accounts.items()))
+
+    def _transfer(self, m):
+        amt, acct = int(m.group(1)), int(m.group(2))
+        sign = -1 if m.group(0).count("- ") else 1
+        nxt = self.accounts[acct] + sign * amt
+        if nxt < 0:
+            raise _PgError(
+                'new row for relation "accounts" violates check '
+                'constraint "accounts_balance_check"')
+        self.accounts[acct] = nxt
+
+    PATTERNS = [
+        (re.compile(r"SELECT 'm(\d+)=' \|\| COALESCE\("
+                    r"\(SELECT val FROM (txn\d+) WHERE id = (\d+)\), "
+                    r"'~'\)"), "_read_mop"),
+        (re.compile(r"INSERT INTO (txn\d+) AS t \(id, val\) "
+                    r"VALUES \((\d+), '(\d+)'\) ON CONFLICT"),
+         "_append_mop"),
+        (re.compile(r"SELECT 'b=' \|\| COALESCE\(string_agg"),
+         "_bank_read"),
+        (re.compile(r"UPDATE accounts SET balance = balance "
+                    r"[-+] (\d+) WHERE id = (\d+)"), "_transfer"),
+        (re.compile(r"(BEGIN ISOLATION LEVEL \w+|COMMIT)"), None),
+    ]
+
+    def execute(self, sql: str) -> str:
+        """Executes one psql -c payload atomically; returns stdout."""
+        with self.lock:
+            out = []
+            backup = ({t: dict(kv) for t, kv in self.tables.items()},
+                      dict(self.accounts))
+            try:
+                for stmt in filter(None,
+                                   (s.strip() for s in sql.split(";"))):
+                    self.statements.append(stmt)
+                    for pat, meth in self.PATTERNS:
+                        m = pat.search(stmt)
+                        if m:
+                            if meth:
+                                line = getattr(self, meth)(m)
+                                if line is not None:
+                                    out.append(line)
+                            break
+                    else:
+                        raise AssertionError(
+                            f"fake postgres can't parse: {stmt!r}")
+            except _PgError:
+                self.tables, self.accounts = backup  # txn rollback
+                raise
+            return "\n".join(out) + ("\n" if out else "")
+
+
+class _PgError(Exception):
+    pass
+
+
+class FakePsqlFactory:
+    """Builds Psql objects whose run() hits the fake instead of a
+    node; RemoteErrors carry the fake's stderr like real psql."""
+
+    def __init__(self, state=None):
+        self.state = state or FakePostgres()
+
+    def __call__(self, test, node, host, timeout=10.0):
+        factory = self
+
+        class _FakePsql:
+            def run(self, sql):
+                try:
+                    return factory.state.execute(sql)
+                except _PgError as e:
+                    raise RemoteError("psql failed", exit=1, out="",
+                                      err=f"ERROR: {e}", cmd="psql",
+                                      node=node)
+
+            def close(self):
+                pass
+
+        return _FakePsql()
+
+
+class TestAppendClient:
+    def _client(self, state=None):
+        f = FakePsqlFactory(state)
+        c = pg.PgAppendClient(psql_factory=f).open(
+            {"nodes": ["n1"]}, "n1")
+        return c, f.state
+
+    def _invoke(self, c, mops):
+        from jepsen_tpu.history import Op
+
+        return c.invoke({}, Op(type="invoke", process=0, f="txn",
+                               value=mops))
+
+    def test_append_then_read(self):
+        c, _ = self._client()
+        r1 = self._invoke(c, [["append", 1, 10]])
+        assert r1.type == "ok"
+        r2 = self._invoke(c, [["r", 1, None]])
+        assert r2.value == [["r", 1, [10]]]
+
+    def test_read_missing_key_is_none(self):
+        c, _ = self._client()
+        r = self._invoke(c, [["r", 9, None]])
+        assert r.value == [["r", 9, None]]
+
+    def test_multi_mop_txn_reads_own_writes(self):
+        c, state = self._client()
+        r = self._invoke(c, [["append", 2, 7], ["r", 2, None],
+                             ["append", 2, 8], ["r", 2, None]])
+        assert r.type == "ok"
+        assert r.value == [["append", 2, 7], ["r", 2, [7]],
+                           ["append", 2, 8], ["r", 2, [7, 8]]]
+        # and it all went through one serializable block
+        assert any("BEGIN ISOLATION LEVEL SERIALIZABLE" in s
+                   for s in state.statements)
+
+    def test_serialization_failure_is_definite_fail(self):
+        c, state = self._client()
+
+        real = state.execute
+        state.execute = lambda sql: (_ for _ in ()).throw(
+            _PgError("could not serialize access due to concurrent "
+                     "update"))
+        r = self._invoke(c, [["append", 1, 1], ["r", 1, None]])
+        assert r.type == "fail"
+        assert "serialize" in r.error
+        state.execute = real
+
+    def test_tables_partition_keyspace(self):
+        c, state = self._client()
+        self._invoke(c, [["append", 0, 1]])
+        self._invoke(c, [["append", 1, 1]])
+        self._invoke(c, [["append", 5, 1]])
+        assert state.tables["txn0"] == {0: "1"}
+        assert state.tables["txn1"] == {1: "1"}
+        assert state.tables["txn2"] == {5: "1"}
+
+
+class TestEndToEnd:
+    def _run(self, workload_fn, opts, factory):
+        w = workload_fn(opts)
+        w["client"].psql_factory = factory
+        test = testing.noop_test()
+        test.update(nodes=["n1", "n2", "n3"],
+                    concurrency=opts.get("concurrency", 6),
+                    client=w["client"], checker=w["checker"],
+                    generator=gen.clients(
+                        gen.stagger(0.0005, gen.limit(
+                            opts.get("ops", 300), w["generator"]))))
+        return core.run(test)
+
+    def test_append_workload_valid(self):
+        test = self._run(pg.append_workload,
+                         {"ops": 300, "keys": 5, "seed": 11,
+                          "concurrency": 6},
+                         FakePsqlFactory())
+        assert test["results"]["valid?"] is True
+        oks = [op for op in test["history"]
+               if op.type == "ok" and op.f == "txn"]
+        assert len(oks) > 50
+
+    def test_append_detects_incompatible_order(self):
+        """A fake that serves one key's list REVERSED to half the
+        reads yields incompatible version orders -> invalid."""
+
+        class Corrupt(FakePostgres):
+            def __init__(self):
+                super().__init__()
+                self.n = 0
+
+            def _read_mop(self, m):
+                t, k = m.group(2), int(m.group(3))
+                val = self.tables[t].get(k)
+                self.n += 1
+                if val is not None and "," in val and self.n % 2:
+                    val = ",".join(reversed(val.split(",")))
+                return f"m{m.group(1)}=" + ("~" if val is None
+                                            else val)
+
+        test = self._run(pg.append_workload,
+                         {"ops": 400, "keys": 2, "seed": 13,
+                          "concurrency": 6},
+                         FakePsqlFactory(Corrupt()))
+        assert test["results"]["valid?"] is False
+
+    def test_bank_workload_valid(self):
+        test = self._run(pg.bank_workload,
+                         {"ops": 300, "seed": 17, "concurrency": 6},
+                         FakePsqlFactory())
+        assert test["results"]["valid?"] is True
+        reads = [op for op in test["history"]
+                 if op.type == "ok" and op.f == "read"]
+        assert reads and all(sum(op.value.values()) == 80
+                             for op in reads)
+
+    def test_bank_detects_lost_debit(self):
+        """A fake that drops the debit half of transfers inflates the
+        total -> wrong-total error."""
+
+        class Lossy(FakePostgres):
+            def _transfer(self, m):
+                if "- " in m.group(0):
+                    return  # lose every debit
+                super()._transfer(m)
+
+        test = self._run(pg.bank_workload,
+                         {"ops": 200, "seed": 19, "concurrency": 4},
+                         FakePsqlFactory(Lossy()))
+        assert test["results"]["valid?"] is False
+
+    def test_overdraft_aborts_whole_txn(self):
+        """CHECK constraint: a transfer bigger than the balance
+        definitively fails and mutates nothing."""
+        state = FakePostgres(accounts=2, balance=3)
+        f = FakePsqlFactory(state)
+        c = pg.PgBankClient(psql_factory=f).open(
+            {"nodes": ["n1"]}, "n1")
+        from jepsen_tpu.history import Op
+
+        r = c.invoke({}, Op(type="invoke", process=0, f="transfer",
+                            value={"from": 0, "to": 1, "amount": 99}))
+        assert r.type == "fail"
+        assert state.accounts == {0: 3, 1: 3}
+
+
+class TestCli:
+    def test_test_map_shape(self):
+        opts = {"nodes": ["n1", "n2", "n3"], "concurrency": 6,
+                "ssh": {"dummy": True}, "workload": "bank",
+                "time_limit": 5}
+        test = pg.postgres_test(opts)
+        assert test["name"] == "postgres-bank"
+        assert isinstance(test["db"], pg.PostgresDB)
+
+    def test_isolation_threads_to_client(self):
+        opts = {"nodes": ["n1"], "concurrency": 2,
+                "ssh": {"dummy": True}, "workload": "append",
+                "isolation": "REPEATABLE READ"}
+        test = pg.postgres_test(opts)
+        assert test["client"].isolation == "REPEATABLE READ"
