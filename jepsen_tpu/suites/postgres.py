@@ -227,11 +227,12 @@ class PgAppendClient(jclient.Client):
         mops = op.value
         stmts = [self._mop_sql(i, f, k, v)
                  for i, (f, k, v) in enumerate(mops)]
-        if len(mops) > 1:
-            sql = (f"BEGIN ISOLATION LEVEL {self.isolation}; "
-                   + "; ".join(stmts) + "; COMMIT;")
-        else:
-            sql = stmts[0] + ";"
+        # ALWAYS wrap, even single mops: postgres SSI only promises
+        # serializability among SERIALIZABLE transactions — a lone
+        # read at the session default can witness the read-only
+        # anomaly and elle would flag a healthy server
+        sql = (f"BEGIN ISOLATION LEVEL {self.isolation}; "
+               + "; ".join(stmts) + "; COMMIT;")
         try:
             out = self.psql.run(sql)
         except RemoteError as e:

@@ -1,0 +1,276 @@
+"""RabbitMQ suite tests: DB clustering command emission via the dummy
+remote, and clusterless end-to-end queue-conservation runs against an
+in-memory broker (mirrors rabbitmq/src/jepsen/rabbitmq.clj)."""
+
+import collections
+import threading
+
+from jepsen_tpu import control, core, testing
+from jepsen_tpu import generator as gen
+from jepsen_tpu.control.core import Action, RemoteError, Result
+from jepsen_tpu.control.dummy import DummyRemote
+from jepsen_tpu.suites import rabbitmq as rmq
+
+
+def responder(node, action):
+    if action.cmd.startswith("stat "):
+        return Result(exit=1, out="", err="no such file",
+                      cmd=action.cmd)
+    if action.cmd.startswith("dirname "):
+        return action.cmd.split()[-1].rsplit("/", 1)[0]
+    return None
+
+
+def make_test(nodes=("n1", "n2", "n3")):
+    remote = DummyRemote(responder)
+    t = testing.noop_test()
+    t.update(nodes=list(nodes), remote=remote,
+             sessions={n: remote.connect({"host": n}) for n in nodes})
+    return core.prepare_test(t)  # real barrier for synchronize()
+
+
+def cmds(test, node):
+    return [a.cmd for a in test["sessions"][node].log
+            if isinstance(a, Action)]
+
+
+class TestDB:
+    def _setup_all(self, test):
+        """Parallel setup like core.run does — the synchronize barrier
+        requires all nodes in flight together."""
+        db = rmq.RabbitDB("3.5.6")
+        control.on_nodes(test, lambda t, n: db.setup(t, n))
+
+    def test_cluster_join_flow(self):
+        test = make_test()
+        self._setup_all(test)
+        got1 = " ; ".join(cmds(test, "n1"))
+        got2 = " ; ".join(cmds(test, "n2"))
+        # cookie set everywhere before clustering
+        for got in (got1, got2):
+            assert "jepsen-rabbitmq > /var/lib/rabbitmq/.erlang.cookie" \
+                in got
+            assert "rabbitmq_management" in got
+        # primary never joins; secondaries stop_app -> join -> start_app
+        assert "join_cluster" not in got1
+        assert "rabbitmqctl stop_app" in got2
+        assert "rabbitmqctl join_cluster rabbit@n1" in got2
+        assert got2.index("stop_app") < got2.index("join_cluster")
+        assert "rabbitmqctl start_app" in got2
+        # mirroring policy on every node after the join barrier
+        assert "set_policy ha-maj" in got1 and "ha-mode" in got1
+
+    def test_teardown_nukes_mnesia(self):
+        test = make_test()
+        db = rmq.RabbitDB()
+        with control.with_session(test, "n1"):
+            db.teardown(test, "n1")
+        got = " ; ".join(cmds(test, "n1"))
+        assert "killall -9 beam.smp epmd" in got
+        assert "/var/lib/rabbitmq/mnesia/" in got
+
+
+class FakeBroker:
+    """In-memory durable queue with rabbitmqadmin raw_json shapes."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.q = collections.deque()
+
+    def publish(self, payload):
+        with self.lock:
+            self.q.append(payload)
+        return "Message published"
+
+    def get(self):
+        with self.lock:
+            if not self.q:
+                return "[]"
+            v = self.q.popleft()
+        return f'[{{"payload": "{v}", "routing_key": "jepsen.queue"}}]'
+
+
+class FakeAdminFactory:
+    def __init__(self, broker=None):
+        self.broker = broker or FakeBroker()
+        self.declared: list = []
+
+    def __call__(self, test, node, timeout=8.0):
+        factory = self
+
+        class _Admin:
+            def run(self, *args):
+                if args[0] == "declare":
+                    factory.declared.append(args)
+                    return "queue declared"
+                if args[0] == "publish":
+                    payload = next(a for a in args
+                                   if a.startswith("payload="))
+                    return factory.broker.publish(
+                        payload.split("=", 1)[1])
+                if args[0] == "get":
+                    return factory.broker.get()
+                raise AssertionError(f"unexpected {args}")
+
+            def close(self):
+                pass
+
+        return _Admin()
+
+
+def run_queue_test(factory, ops=200, concurrency=4):
+    w = rmq.queue_workload({"ops": ops})
+    w["client"].admin_factory = factory
+    test = testing.noop_test()
+    test.update(
+        nodes=["n1", "n2"], concurrency=concurrency,
+        client=w["client"], checker=w["checker"],
+        generator=gen.phases(
+            gen.clients(gen.stagger(0.0003, w["mix"])),
+            gen.clients(w["drain"])))
+    return core.run(test)
+
+
+class TestEndToEnd:
+    def test_conservation_holds(self):
+        test = run_queue_test(FakeAdminFactory())
+        assert test["results"]["valid?"] is True
+        res = test["results"]["total-queue"]
+        assert not res["lost"] and not res["unexpected"]
+        assert res["ok-count"] > 0
+
+    def test_queue_declared_at_setup(self):
+        factory = FakeAdminFactory()
+        run_queue_test(factory)
+        assert any("name=jepsen.queue" in a for d in factory.declared
+                   for a in d)
+
+    def test_lost_message_detected(self):
+        """A broker that drops every 10th confirmed publish loses
+        messages the drain never recovers -> invalid."""
+
+        class Lossy(FakeBroker):
+            def __init__(self):
+                super().__init__()
+                self.n = 0
+
+            def publish(self, payload):
+                self.n += 1
+                if self.n % 10 == 0:
+                    return "Message published"  # confirmed but gone
+                return super().publish(payload)
+
+        test = run_queue_test(FakeAdminFactory(Lossy()))
+        assert test["results"]["valid?"] is False
+        assert test["results"]["total-queue"]["lost"]
+
+    def test_duplicate_delivery_detected(self):
+        """A broker that re-delivers a message it already served must
+        surface as unexpected/duplicate in total-queue."""
+
+        class Dup(FakeBroker):
+            def __init__(self):
+                super().__init__()
+                self.duped = False
+
+            def get(self):
+                with self.lock:
+                    if not self.q:
+                        return "[]"
+                    v = self.q[0]
+                    if self.duped or len(self.q) == 1:
+                        self.q.popleft()  # normal delivery
+                    else:
+                        self.duped = True  # serve head once more later
+                return (f'[{{"payload": "{v}", '
+                        f'"routing_key": "jepsen.queue"}}]')
+
+        test = run_queue_test(FakeAdminFactory(Dup()), ops=60,
+                              concurrency=2)
+        res = test["results"]["total-queue"]
+        assert res["duplicated"] or res["unexpected"]
+
+
+class TestClientErrors:
+    def test_enqueue_crash_is_info_dequeue_fail(self):
+        class Down:
+            def __call__(self, test, node, timeout=8.0):
+                class _Admin:
+                    def run(self, *args):
+                        raise RemoteError("broker down", exit=1,
+                                          out="", err="conn refused",
+                                          cmd="rabbitmqadmin",
+                                          node=node)
+
+                    def close(self):
+                        pass
+
+                return _Admin()
+
+        client = rmq.RabbitQueueClient(admin_factory=Down()).open(
+            {}, "n1")
+        from jepsen_tpu.history import Op
+
+        enq = client.invoke({}, Op(type="invoke", process=0,
+                                   f="enqueue", value=7))
+        deq = client.invoke({}, Op(type="invoke", process=0,
+                                   f="dequeue", value=None))
+        assert enq.type == "info"  # may have landed
+        assert deq.type == "info"  # get-with-ack may have consumed
+
+    def test_unrouted_publish_is_definite_fail(self):
+        class Unrouted:
+            def __call__(self, test, node, timeout=8.0):
+                class _Admin:
+                    def run(self, *args):
+                        return "Message published but NOT routed"
+
+                    def close(self):
+                        pass
+
+                return _Admin()
+
+        client = rmq.RabbitQueueClient(
+            admin_factory=Unrouted()).open({}, "n1")
+        from jepsen_tpu.history import Op
+
+        enq = client.invoke({}, Op(type="invoke", process=0,
+                                   f="enqueue", value=7))
+        assert enq.type == "fail"
+
+    def test_drain_error_keeps_collected_values(self):
+        calls = {"n": 0}
+
+        class Flaky:
+            def __call__(self, test, node, timeout=8.0):
+                class _Admin:
+                    def run(self, *args):
+                        if args[0] == "get":
+                            calls["n"] += 1
+                            if calls["n"] <= 2:
+                                return ('[{"payload": "%d"}]'
+                                        % calls["n"])
+                            raise RemoteError("conn reset", exit=1,
+                                              out="", err="reset",
+                                              cmd="x", node=node)
+                        return ""
+
+                    def close(self):
+                        pass
+
+                return _Admin()
+
+        client = rmq.RabbitQueueClient(admin_factory=Flaky()).open(
+            {}, "n1")
+        from jepsen_tpu.history import Op
+
+        r = client.invoke({}, Op(type="invoke", process=0, f="drain",
+                                 value=None))
+        assert r.type == "ok" and r.value == [1, 2]
+
+    def test_cli_map(self):
+        opts = {"nodes": ["n1", "n2", "n3"], "concurrency": 3,
+                "ssh": {"dummy": True}, "time_limit": 5}
+        test = rmq.rabbitmq_test(opts)
+        assert test["name"] == "rabbitmq-queue"
+        assert isinstance(test["db"], rmq.RabbitDB)
