@@ -19,7 +19,8 @@ from pathlib import Path
 
 logger = logging.getLogger(__name__)
 
-_SRC = Path(__file__).with_name("jlog.c")
+_SRCS = (Path(__file__).with_name("jlog.c"),
+         Path(__file__).with_name("order.c"))
 _LOCK = threading.Lock()
 _lib = None
 _tried = False
@@ -33,13 +34,22 @@ def _build_dir() -> Path:
 
 
 def _compile() -> Path | None:
-    out = _build_dir() / "jlog.so"
-    if out.exists() and out.stat().st_mtime >= _SRC.stat().st_mtime:
+    # Cache keyed on source CONTENT: mtime comparisons break when a
+    # stale .so outlives a package upgrade (archive mtimes can sort
+    # older), and loading one without the newer symbols would brick
+    # the whole codec for the process
+    import hashlib
+
+    digest = hashlib.sha256()
+    for s in _SRCS:
+        digest.update(s.read_bytes())
+    out = _build_dir() / f"jlog-{digest.hexdigest()[:16]}.so"
+    if out.exists():
         return out
     for cc in ("cc", "gcc", "g++"):
         try:
             proc = subprocess.run(
-                [cc, "-O2", "-shared", "-fPIC", str(_SRC),
+                [cc, "-O2", "-shared", "-fPIC", *map(str, _SRCS),
                  "-o", str(out), "-lz"],
                 capture_output=True, text=True, timeout=120)
         except (OSError, subprocess.TimeoutExpired):
@@ -73,6 +83,12 @@ def jlog() -> ctypes.CDLL | None:
             lib.jlog_frame.argtypes = [
                 ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64),
                 ctypes.c_int64, ctypes.c_char_p]
+            lib.jt_realtime_edges.restype = ctypes.c_int64
+            lib.jt_realtime_edges.argtypes = [
+                ctypes.POINTER(ctypes.c_int64),
+                ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
+                ctypes.POINTER(ctypes.c_int64),
+                ctypes.POINTER(ctypes.c_int64), ctypes.c_int64]
             _lib = lib
         except Exception:  # noqa: BLE001 — never break the store
             logger.exception("loading native jlog codec failed")
@@ -111,3 +127,35 @@ def frame(payloads: list[bytes]) -> bytes:
     out = ctypes.create_string_buffer(len(blob) + 8 * len(payloads))
     written = lib.jlog_frame(blob, lens, len(payloads), out)
     return out.raw[:written]
+
+
+def realtime_edges(inv, comp):
+    """(src_idx, dst_idx) int64 arrays of reduced realtime-order edges
+    over dense txn positions, via the C sweep (order.c); raises
+    RuntimeError if the codec is unavailable. inv/comp are int64
+    arrays of invocation/completion history positions."""
+    import numpy as np
+
+    lib = jlog()
+    if lib is None or not hasattr(lib, "jt_realtime_edges"):
+        raise RuntimeError("native order sweep unavailable")
+    inv = np.ascontiguousarray(inv, dtype=np.int64)
+    comp = np.ascontiguousarray(comp, dtype=np.int64)
+    n = len(inv)
+    if n == 0:
+        return (np.empty(0, dtype=np.int64),
+                np.empty(0, dtype=np.int64))
+    p = ctypes.POINTER(ctypes.c_int64)
+    cap = max(8 * n, 1024)
+    while True:
+        src = np.empty(cap, dtype=np.int64)
+        dst = np.empty(cap, dtype=np.int64)
+        m = lib.jt_realtime_edges(
+            inv.ctypes.data_as(p), comp.ctypes.data_as(p), n,
+            src.ctypes.data_as(p), dst.ctypes.data_as(p), cap)
+        if m == -1:
+            cap *= 4
+            continue
+        if m < 0:
+            raise RuntimeError(f"native order sweep failed ({m})")
+        return src[:m].copy(), dst[:m].copy()

@@ -266,43 +266,66 @@ def order_edge_arrays(committed: list[Txn]):
     A completed before B invoked — exactly elle's realtime relation,
     with O(n * concurrency) edges instead of O(n^2). Returns int
     (src, dst, type) arrays; the single implementation behind both the
-    host and device engines."""
+    host and device engines. Process chains are a lexsort; the sweep
+    runs in C (native/order.c) with this Python loop as fallback."""
+    n = len(committed)
+    if n == 0:
+        e = np.empty(0, dtype=np.int64)
+        return e, e.copy(), e.copy()
+    ids = np.fromiter((t.i for t in committed), dtype=np.int64,
+                      count=n)
+    inv = np.fromiter((t.invoke_pos for t in committed),
+                      dtype=np.int64, count=n)
+    comp = np.fromiter((t.complete_pos for t in committed),
+                       dtype=np.int64, count=n)
+    proc_ids: dict = {}
+    procid = np.fromiter(
+        (proc_ids.setdefault(t.process, len(proc_ids))
+         for t in committed), dtype=np.int64, count=n)
+    # session order: adjacent pairs within each process
+    order = np.lexsort((inv, procid))
+    same = procid[order][1:] == procid[order][:-1]
+    p_src = ids[order[:-1][same]]
+    p_dst = ids[order[1:][same]]
+    # realtime order: completion-frontier sweep
+    try:
+        from .. import native
+
+        r_src_i, r_dst_i = native.realtime_edges(inv, comp)
+        r_src, r_dst = ids[r_src_i], ids[r_dst_i]
+    except RuntimeError:
+        r_src, r_dst = _realtime_edges_py(committed)
+    src = np.concatenate([p_src, r_src])
+    dst = np.concatenate([p_dst, r_dst])
+    ty = np.concatenate([np.full(len(p_src), PROC, dtype=np.int64),
+                         np.full(len(r_src), RT, dtype=np.int64)])
+    return src, dst, ty
+
+
+def _realtime_edges_py(committed: list[Txn]):
+    """Pure-Python frontier sweep (the C path's reference semantics).
+    On a completion, drop frontier members the completing txn already
+    covers; on an invocation, link every frontier member in."""
     src: list[int] = []
     dst: list[int] = []
-    ty: list[int] = []
-    by_proc: dict = defaultdict(list)
-    for t in committed:
-        by_proc[t.process].append(t)
-    for ts in by_proc.values():
-        ts.sort(key=lambda t: t.invoke_pos)
-        for a, b in zip(ts, ts[1:]):
-            src.append(a.i)
-            dst.append(b.i)
-            ty.append(PROC)
-    # Sweep events in history order. On a completion, drop frontier
-    # members the completing txn already covers (their completion
-    # precedes its invocation, so an edge to it was emitted at its
-    # invoke); on an invocation, link every frontier member in.
     events = []
     for t in committed:
         events.append((t.invoke_pos, 1, t))
         events.append((t.complete_pos, 0, t))
     events.sort(key=lambda e: (e[0], e[1]))
     frontier: list[Txn] = []
-    for pos, is_inv, t in events:
+    for _pos, is_inv, t in events:
         if is_inv:
             for a in frontier:
                 if a.i != t.i:
                     src.append(a.i)
                     dst.append(t.i)
-                    ty.append(RT)
         else:
             frontier[:] = [y for y in frontier
                            if y.complete_pos >= t.invoke_pos]
             frontier.append(t)
     return (np.asarray(src, dtype=np.int64),
-            np.asarray(dst, dtype=np.int64),
-            np.asarray(ty, dtype=np.int64))
+            np.asarray(dst, dtype=np.int64))
 
 
 def _order_edges(committed: list[Txn]) -> list[tuple[int, int, int]]:
