@@ -43,19 +43,32 @@ def _compile() -> Path | None:
     digest = hashlib.sha256()
     for s in _SRCS:
         digest.update(s.read_bytes())
-    out = _build_dir() / f"jlog-{digest.hexdigest()[:16]}.so"
+    build = _build_dir()
+    out = build / f"jlog-{digest.hexdigest()[:16]}.so"
     if out.exists():
         return out
     for cc in ("cc", "gcc", "g++"):
+        # Compile to a private temp name and os.replace into place: a
+        # killed compile (or a concurrent process — _LOCK is
+        # thread-local) must never leave a half-written .so at the
+        # cache path, where it would be trusted forever
+        tmp = build / f".jlog-{os.getpid()}.so.tmp"
         try:
             proc = subprocess.run(
                 [cc, "-O2", "-shared", "-fPIC", *map(str, _SRCS),
-                 "-o", str(out), "-lz"],
+                 "-o", str(tmp), "-lz"],
                 capture_output=True, text=True, timeout=120)
         except (OSError, subprocess.TimeoutExpired):
+            tmp.unlink(missing_ok=True)
             continue
         if proc.returncode == 0:
+            os.replace(tmp, out)
+            # prune superseded builds (incl. the legacy fixed name)
+            for old in build.glob("jlog*.so"):
+                if old != out:
+                    old.unlink(missing_ok=True)
             return out
+        tmp.unlink(missing_ok=True)
         logger.debug("%s failed to build jlog.so: %s", cc, proc.stderr)
     return None
 

@@ -17,6 +17,11 @@
 #include <string.h>
 #include <zlib.h>
 
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+
 #define HDR 8 /* u32 len + u32 crc */
 
 /* Scans framed records in buf[0..len). Writes up to max_records pairs
@@ -68,3 +73,7 @@ int64_t jlog_frame(const uint8_t *payloads, const int64_t *lens,
     }
     return out_pos;
 }
+
+#ifdef __cplusplus
+}  /* extern "C" */
+#endif

@@ -17,6 +17,11 @@
 #include <stdint.h>
 #include <stdlib.h>
 
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+
 typedef struct {
     int64_t pos;
     int32_t is_inv;
@@ -38,8 +43,10 @@ int64_t jt_realtime_edges(const int64_t *inv, const int64_t *comp,
                           int64_t *out_dst, int64_t cap) {
     if (n <= 0)
         return 0;
-    jt_event *events = malloc(sizeof(jt_event) * 2 * (size_t)n);
-    int64_t *frontier = malloc(sizeof(int64_t) * (size_t)n);
+    jt_event *events =
+        (jt_event *)malloc(sizeof(jt_event) * 2 * (size_t)n);
+    int64_t *frontier =
+        (int64_t *)malloc(sizeof(int64_t) * (size_t)n);
     if (!events || !frontier) {
         free(events);
         free(frontier);
@@ -87,3 +94,7 @@ int64_t jt_realtime_edges(const int64_t *inv, const int64_t *comp,
     free(frontier);
     return m;
 }
+
+#ifdef __cplusplus
+}  /* extern "C" */
+#endif

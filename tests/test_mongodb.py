@@ -1,0 +1,283 @@
+"""MongoDB suite tests: DB/replica-set command emission via the dummy
+remote, runCommand semantics against an in-memory replica document
+store, and clusterless end-to-end document-cas runs (mirrors
+mongodb-smartos/src/jepsen/mongodb_smartos/{core,document_cas}.clj)."""
+
+import threading
+
+from jepsen_tpu import control, core, independent, testing
+from jepsen_tpu import generator as gen
+from jepsen_tpu.control.core import Action, RemoteError, Result
+from jepsen_tpu.control.dummy import DummyRemote
+from jepsen_tpu.history import Op
+from jepsen_tpu.suites import mongodb as mdb
+
+
+def responder(node, action):
+    if action.cmd.startswith("stat "):
+        return Result(exit=1, out="", err="no such file",
+                      cmd=action.cmd)
+    if action.cmd.startswith("dirname "):
+        return action.cmd.split()[-1].rsplit("/", 1)[0]
+    if action.cmd.startswith("ls -A"):
+        return "mongodb-linux-x86_64"
+    return None
+
+
+def make_test(nodes=("n1", "n2", "n3")):
+    remote = DummyRemote(responder)
+    t = testing.noop_test()
+    t.update(nodes=list(nodes), remote=remote,
+             sessions={n: remote.connect({"host": n}) for n in nodes})
+    return core.prepare_test(t)
+
+
+def cmds(test, node):
+    return [a.cmd for a in test["sessions"][node].log
+            if isinstance(a, Action)]
+
+
+class TestDB:
+    def test_setup_commands(self):
+        test = make_test()
+        db = mdb.MongoDB("7.0.14", shell_factory=None)
+        control.on_nodes(test, lambda t, n: db.setup(t, n))
+        got = " ; ".join(cmds(test, "n2"))
+        assert "mongodb-linux-x86_64-debian11-7.0.14.tgz" in got
+        assert "mongosh-2.3.1-linux-x64.tgz" in got
+        assert "--replSet rs0" in got
+        assert "--bind_ip_all" in got
+        assert "--dbpath /var/lib/mongodb" in got
+
+    def test_teardown_wipes(self):
+        test = make_test()
+        db = mdb.MongoDB(shell_factory=None)
+        with control.with_session(test, "n1"):
+            db.teardown(test, "n1")
+        got = " ; ".join(cmds(test, "n1"))
+        assert "/var/lib/mongodb" in got
+
+    def test_initiate_runs_on_primary_only(self):
+        calls = []
+
+        class Shell:
+            def __init__(self, test, node, direct=False, timeout=10.0):
+                self.node = node
+
+            def run_command(self, command, admin=False):
+                calls.append((self.node, next(iter(command))))
+                if "replSetInitiate" in command:
+                    return {"ok": 1}
+                return {"ok": 1, "isWritablePrimary": True}
+
+            def close(self):
+                pass
+
+        test = make_test()
+        db = mdb.MongoDB(shell_factory=Shell)
+        control.on_nodes(test, lambda t, n: db.setup(t, n))
+        assert ("n1", "replSetInitiate") in calls
+        assert not any(n != "n1" for n, c in calls
+                       if c == "replSetInitiate")
+        assert ("n1", "hello") in calls
+
+
+class FakeMongo:
+    """In-memory document store speaking the runCommand subset the
+    suite uses (find/update with upsert + query guards)."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.docs: dict = {}  # _id -> value
+        self.commands: list = []
+
+    def run_command(self, command, admin=False):
+        self.commands.append(command)
+        with self.lock:
+            if "find" in command:
+                k = command["filter"]["_id"]
+                if k in self.docs:
+                    batch = [{"_id": k, "value": self.docs[k]}]
+                else:
+                    batch = []
+                return {"ok": 1, "cursor": {"firstBatch": batch}}
+            if "update" in command:
+                u = command["updates"][0]
+                q, upd = u["q"], u["u"]
+                matched = (q["_id"] in self.docs
+                           and all(self.docs[q["_id"]] == v
+                                   for key, v in q.items()
+                                   if key == "value"))
+                if "value" in q:  # guarded cas
+                    if not matched:
+                        return {"ok": 1, "n": 0, "nModified": 0}
+                    self.docs[q["_id"]] = upd["$set"]["value"]
+                    return {"ok": 1, "n": 1, "nModified": 1}
+                # plain upsert write
+                self.docs[q["_id"]] = upd["value"]
+                return {"ok": 1, "n": 1, "nModified": 1}
+            raise AssertionError(f"unexpected command {command}")
+
+
+class FakeShellFactory:
+    def __init__(self, state=None):
+        self.state = state or FakeMongo()
+
+    def __call__(self, test, node, direct=False, timeout=10.0):
+        factory = self
+
+        class _Shell:
+            def run_command(self, command, admin=False):
+                return factory.state.run_command(command, admin)
+
+            def close(self):
+                pass
+
+        return _Shell()
+
+
+def kop(f, k, v=None):
+    return Op(type="invoke", process=0, f=f,
+              value=independent.ktuple(k, v))
+
+
+class TestClient:
+    def _client(self, state=None):
+        f = FakeShellFactory(state)
+        c = mdb.MongoCasClient(shell_factory=f).open(
+            {"nodes": ["n1"]}, "n1")
+        return c, f.state
+
+    def test_read_write_cas_roundtrip(self):
+        c, _ = self._client()
+        assert c.invoke({}, kop("read", 0)).value == \
+            independent.ktuple(0, None)
+        assert c.invoke({}, kop("write", 0, 3)).type == "ok"
+        assert c.invoke({}, kop("read", 0)).value == \
+            independent.ktuple(0, 3)
+        assert c.invoke({}, kop("cas", 0, [3, 4])).type == "ok"
+        assert c.invoke({}, kop("cas", 0, [3, 9])).type == "fail"
+        assert c.invoke({}, kop("read", 0)).value == \
+            independent.ktuple(0, 4)
+
+    def test_write_concern_threads_through(self):
+        c, state = self._client()
+        c.invoke({}, kop("write", 0, 1))
+        wc = state.commands[-1]["writeConcern"]
+        assert wc == {"w": "majority"}
+
+    def test_numeric_write_concern(self):
+        f = FakeShellFactory()
+        c = mdb.MongoCasClient(shell_factory=f,
+                               write_concern="1").open(
+            {"nodes": ["n1"]}, "n1")
+        c.invoke({}, kop("write", 0, 1))
+        assert f.state.commands[-1]["writeConcern"] == {"w": 1}
+
+    def test_read_concern_on_reads(self):
+        c, state = self._client()
+        c.invoke({}, kop("read", 0))
+        assert state.commands[-1]["readConcern"] == {
+            "level": "linearizable"}
+
+    def test_not_primary_is_definite_fail(self):
+        class Down:
+            def __call__(self, test, node, direct=False, timeout=10.0):
+                class _Shell:
+                    def run_command(self, command, admin=False):
+                        raise RemoteError(
+                            "mongosh failed", exit=1, out="",
+                            err="NotWritablePrimary", cmd="mongosh",
+                            node=node)
+
+                    def close(self):
+                        pass
+
+                return _Shell()
+
+        c = mdb.MongoCasClient(shell_factory=Down()).open(
+            {"nodes": ["n1"]}, "n1")
+        assert c.invoke({}, kop("write", 0, 1)).type == "fail"
+
+    def test_timeout_write_is_info(self):
+        class Slow:
+            def __call__(self, test, node, direct=False, timeout=10.0):
+                class _Shell:
+                    def run_command(self, command, admin=False):
+                        raise RemoteError("mongosh timed out",
+                                          cmd="mongosh", node=node)
+
+                    def close(self):
+                        pass
+
+                return _Shell()
+
+        c = mdb.MongoCasClient(shell_factory=Slow()).open(
+            {"nodes": ["n1"]}, "n1")
+        assert c.invoke({}, kop("write", 0, 1)).type == "info"
+        assert c.invoke({}, kop("read", 0)).type == "fail"
+
+
+class TestEndToEnd:
+    def _run(self, factory, opts):
+        w = mdb.cas_workload(opts)
+        w["client"].shell_factory = factory
+        test = testing.noop_test()
+        test.update(nodes=["n1", "n2", "n3"],
+                    concurrency=opts["concurrency"],
+                    client=w["client"], checker=w["checker"],
+                    generator=gen.clients(
+                        gen.stagger(0.0005, w["generator"])))
+        return core.run(test)
+
+    def test_cas_workload_valid(self):
+        test = self._run(FakeShellFactory(),
+                         {"concurrency": 6, "keys": 2,
+                          "ops_per_key": 60, "seed": 7})
+        assert test["results"]["valid?"] is True
+        fs = {op.f for op in test["history"]}
+        assert fs == {"read", "write", "cas"}
+
+    def test_stale_read_detected(self):
+        """A fake that serves every read from a stale snapshot is not
+        linearizable once writes land."""
+
+        class Stale(FakeMongo):
+            def __init__(self):
+                super().__init__()
+                self.snapshot: dict = {}
+                self.reads = 0
+
+            def run_command(self, command, admin=False):
+                if "find" in command:
+                    self.reads += 1
+                    if self.reads > 10:  # serve from frozen state
+                        k = command["filter"]["_id"]
+                        batch = ([{"_id": k,
+                                   "value": self.snapshot.get(k, -7)}]
+                                 if True else [])
+                        return {"ok": 1,
+                                "cursor": {"firstBatch": batch}}
+                return super().run_command(command, admin)
+
+        test = self._run(FakeShellFactory(Stale()),
+                         {"concurrency": 6, "keys": 1,
+                          "ops_per_key": 80, "seed": 3})
+        assert test["results"]["valid?"] is False
+
+
+class TestCli:
+    def test_test_map_shape(self):
+        opts = {"nodes": ["n1", "n2", "n3"], "concurrency": 6,
+                "ssh": {"dummy": True}, "time_limit": 5}
+        test = mdb.mongodb_test(opts)
+        assert test["name"] == "mongodb-cas"
+        assert isinstance(test["db"], mdb.MongoDB)
+
+    def test_concerns_reach_client(self):
+        opts = {"nodes": ["n1"], "concurrency": 2,
+                "ssh": {"dummy": True}, "write_concern": "1",
+                "read_concern": "majority"}
+        test = mdb.mongodb_test(opts)
+        assert test["client"].write_concern == "1"
+        assert test["client"].read_concern == "majority"
