@@ -317,3 +317,52 @@ class TestStoreReviewRegressions:
         got = fmt.read_partial_results(tmp_path / "r.jlog")
         assert set(got) == {"workload", "stats"}  # no inner flattening
         assert got["workload"]["bank-ish"]["valid?"] is True
+
+
+class TestRepl:
+    """jepsen_tpu.repl helpers (mirror jepsen/src/jepsen/repl.clj)."""
+
+    def _run_one(self, tmp_path, monkeypatch, name="repl-test"):
+        import jepsen_tpu.store as store_mod
+        from jepsen_tpu import checker as chk
+        from jepsen_tpu import core, generator as gen, testing
+
+        monkeypatch.setattr(store_mod, "BASE", tmp_path / "store")
+        state = testing.AtomState()
+        t = testing.noop_test()
+        t.update(name=name, nodes=["n1"], concurrency=2,
+                 db=testing.AtomDB(state),
+                 client=testing.AtomClient(state),
+                 checker=chk.compose({"stats": chk.stats()}),
+                 generator=gen.clients(gen.limit(
+                     20, lambda: {"f": "read"})))
+        return core.run(t)
+
+    def test_latest_test_roundtrip(self, tmp_path, monkeypatch):
+        from jepsen_tpu import repl
+
+        self._run_one(tmp_path, monkeypatch, "repl-a")
+        self._run_one(tmp_path, monkeypatch, "repl-b")
+        t = repl.latest_test()
+        assert t is not None and len(t["history"]) == 40
+        assert t["results"]["valid?"] is True
+        # by-name selection
+        ta = repl.latest_test("repl-a")
+        assert ta["name"] == "repl-a"
+
+    def test_latest_test_empty_store(self, tmp_path, monkeypatch):
+        import jepsen_tpu.store as store_mod
+        from jepsen_tpu import repl
+
+        monkeypatch.setattr(store_mod, "BASE", tmp_path / "nothing")
+        assert repl.latest_test() is None
+
+    def test_summary(self, tmp_path, monkeypatch):
+        from jepsen_tpu import repl
+
+        self._run_one(tmp_path, monkeypatch)
+        s = repl.summary(repl.latest_test())
+        assert s["valid?"] is True and s["ops"] == 40
+        assert s["by-type"] == {"invoke": 20, "ok": 20}
+        assert "stats" in s["checkers"]
+        assert repl.summary(None) == {}
