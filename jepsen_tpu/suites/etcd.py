@@ -524,7 +524,7 @@ def etcd_test(opts: dict) -> dict:
     etcd-test / zookeeper.clj zk-test shape). opts["faults"] selects
     the nemesis menu (partition/packet/kill/pause/clock/
     file-corruption/membership); empty = classic partitioner."""
-    name = opts.get("workload", "register")
+    name = opts.get("workload") or "register"
     w = WORKLOADS[name](opts)
     db = EtcdDB(opts.get("version", VERSION))
     pkg = nemesis_for(opts, db)
@@ -564,8 +564,9 @@ def _suite_generator(opts, client_gen, pkg):
 
 
 def _workload_opt(p):
-    p.add_argument("--workload", default="register",
-                   help="Workload. " + cli.one_of(WORKLOADS))
+    p.add_argument("--workload", default=None,
+                   help="Workload (default register; test-all sweeps "
+                        "all when omitted). " + cli.one_of(WORKLOADS))
     p.add_argument("--version", default=VERSION,
                    help="etcd version tag to install.")
     p.add_argument("--rate", type=float, default=50)
@@ -587,11 +588,34 @@ def _opt_fn(opts: dict) -> dict:
     return opts
 
 
+FAULT_OPTIONS = ([], ["partition"], ["kill"], ["pause"], ["clock"],
+                 ["partition", "kill"], ["membership"])
+
+
+def all_tests(opts: dict):
+    """The workload x fault sweep for test-all (the canonical suite
+    shape: tidb/src/tidb/core.clj:47-60 workload-options). --workload
+    and --nemesis narrow the matrix to the given values, and each
+    combination repeats --test-count times, like the reference."""
+    workloads = ([opts["workload"]] if opts.get("workload")
+                 else sorted(WORKLOADS))
+    fault_options = ([opts["faults"]] if opts.get("faults") is not None
+                     else FAULT_OPTIONS)
+    for _ in range(opts.get("test_count") or 1):
+        for wname in workloads:
+            for faults in fault_options:
+                yield etcd_test({**opts, "workload": wname,
+                                 "faults": list(faults)})
+
+
 def main(argv=None) -> None:
     commands = {}
     commands.update(cli.single_test_cmd(etcd_test,
                                         parser_fn=_workload_opt,
                                         opt_fn=_opt_fn))
+    commands.update(cli.test_all_cmd(all_tests,
+                                     parser_fn=_workload_opt,
+                                     opt_fn=_opt_fn))
     commands.update(cli.serve_cmd())
     cli.run_cli(commands, argv)
 

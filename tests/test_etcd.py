@@ -285,3 +285,34 @@ def test_nemesis_menu():
     subs = [n for _spec, n in getattr(nem, "pairs", [])]
     assert (isinstance(nem, MembershipNemesis)
             or any(isinstance(x, MembershipNemesis) for x in subs)), nem
+
+
+class TestTestAll:
+    """test-all sweep shape (tidb core.clj:47-60 workload-options)."""
+
+    def test_sweep_covers_workloads_and_faults(self):
+        opts = {"nodes": ["n1", "n2", "n3"], "concurrency": 3,
+                "ssh": {"dummy": True}, "time_limit": 1, "seed": 1}
+        tests = list(etcd.all_tests(opts))
+        assert len(tests) == (len(etcd.WORKLOADS)
+                              * len(etcd.FAULT_OPTIONS))
+        names = {t["name"] for t in tests}
+        assert names == {"etcd-register", "etcd-append"}
+        # each test is independently constructed (no shared nemesis
+        # state across sweep entries)
+        nemeses = [id(t["nemesis"]) for t in tests]
+        assert len(set(nemeses)) == len(nemeses)
+
+    def test_sweep_narrows_and_repeats(self):
+        opts = {"nodes": ["n1"], "concurrency": 2,
+                "ssh": {"dummy": True}, "time_limit": 1,
+                "workload": "append", "faults": ["kill"],
+                "test_count": 3, "seed": 1}
+        tests = list(etcd.all_tests(opts))
+        assert len(tests) == 3  # one combo, three repetitions
+        assert {t["name"] for t in tests} == {"etcd-append"}
+
+    def test_single_test_defaults_to_register(self):
+        opts = {"nodes": ["n1"], "concurrency": 2,
+                "ssh": {"dummy": True}, "workload": None}
+        assert etcd.etcd_test(opts)["name"] == "etcd-register"
