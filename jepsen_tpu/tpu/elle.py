@@ -494,10 +494,23 @@ def check_rw_register(hist, opts: dict | None = None) -> dict:
     proven version chain, plus process/realtime order.
 
     opts["engine"]: "host" (scipy SCC per graded subset), "device"
-    (batched SCC kernel with a clean-graph early exit), or "auto"
-    (default: device for large histories)."""
+    (the fully interned array path in elle_device: vectorized edge
+    inference + batched SCC), or "auto" (default: device for large
+    histories). Histories the device path can't intern fall back to
+    this host implementation, which stays the correctness reference."""
     if not isinstance(hist, History):
         hist = History(hist)
+    engine = (opts or {}).get("engine", "auto")
+    want_device = (engine == "device"
+                   or (engine == "auto"
+                       and len(hist) >= _DEVICE_MIN_OPS))
+    if want_device:
+        from . import elle_device
+
+        try:
+            return elle_device.check_rw_register_device(hist)
+        except elle_device.Unvectorizable:
+            pass  # host edge inference below; SCC still on device
     txns = collect(hist)
     anomalies: dict[str, list] = defaultdict(list)
     writer: dict = {}
@@ -584,15 +597,10 @@ def check_rw_register(hist, opts: dict | None = None) -> dict:
                 if w is not None and w.i != t.i and w.type == h.OK:
                     edges.append((t.i, w.i, RW))
     committed = [t for t in txns if t.type == h.OK]
-
-    engine = (opts or {}).get("engine", "auto")
-    if engine == "device" or (engine == "auto"
-                              and len(hist) >= _DEVICE_MIN_OPS):
-        # route cycle detection through the batched SCC kernel: one
-        # full-graph pass proves clean histories, graded subsets run
-        # only when cycles exist (same dispatch as list-append). Order
-        # edges stay arrays end to end — they dominate the edge count,
-        # and tuple round-trips cost more than the SCC itself.
+    if want_device:
+        # unvectorizable values (e.g. strings): edge inference stayed
+        # host-side above, but cycle detection still rides the batched
+        # device SCC over plain int txn-index edges
         from . import elle_device
 
         e = (np.asarray(edges, dtype=np.int64).reshape(-1, 3)

@@ -516,3 +516,309 @@ def check_list_append_device(hist, device: bool = True) -> dict:
         "edge-count": int(len(a.edge_src)),
         "txn-count": len(a.txns),
     }
+
+
+# ---------------------------------------------------------------------------
+# rw-register device path
+# ---------------------------------------------------------------------------
+
+class RwFlat:
+    """Dense-array view of a write/read-register history (the
+    rw-register analog of Flat). One Python pass collects writes
+    (all txn types — they all claim writer slots), committed reads,
+    write-follows-read pairs, external reads, and the per-txn internal
+    anomalies; everything downstream is numpy over packed (key, value)
+    codes."""
+
+    def __init__(self, txns: list[Txn]):
+        self.txns = txns
+        n = len(txns)
+        self.t_type = np.fromiter((_T_CODE[t.type] for t in txns),
+                                  dtype=np.int8, count=n)
+        key_ids: dict = {}
+        wr_txn: list[int] = []
+        wr_key: list[int] = []
+        wr_val: list[int] = []
+        wr_nonfinal: list[int] = []  # row indices of non-final writes
+        rd_txn: list[int] = []
+        rd_key: list[int] = []
+        rd_val: list[int] = []
+        fr_txn: list[int] = []       # write-follows-read rows
+        fr_key: list[int] = []
+        fr_prev: list[int] = []
+        fr_new: list[int] = []
+        er_txn: list[int] = []       # external reads
+        er_key: list[int] = []
+        er_val: list[int] = []
+        internal_bad: list[dict] = []
+
+        def check_val(v):
+            if type(v) is not int or not (0 <= v < (1 << _VAL_BITS)):
+                raise Unvectorizable(f"register value {v!r}")
+
+        for t in txns:
+            ok = t.type == h.OK
+            nonfail = t.type != h.FAIL
+            expected: dict = {}
+            last_read: dict = {}
+            written: set = set()
+            er_seen: set = set()
+            per_key_rows: dict = {}
+            for mop in t.mops:
+                f, k, v = mop[0], mop[1], mop[2]
+                kid = key_ids.get(k)
+                if kid is None:
+                    kid = key_ids[k] = len(key_ids)
+                if f == "w":
+                    check_val(v)
+                    row = len(wr_txn)
+                    wr_txn.append(t.i)
+                    wr_key.append(kid)
+                    wr_val.append(v)
+                    if nonfail:
+                        per_key_rows.setdefault(kid, []).append(row)
+                    if ok:
+                        pv = last_read.pop(kid, None)
+                        if pv is not None:
+                            fr_txn.append(t.i)
+                            fr_key.append(kid)
+                            fr_prev.append(pv)
+                            fr_new.append(v)
+                        expected[kid] = v
+                    written.add(kid)
+                elif f == "r" and ok:
+                    if v is None:
+                        # A None first read IS the key's external read
+                        # (txnlib.ext_reads records it; the host rw
+                        # pass then skips the key) — a later valued
+                        # read must NOT be promoted to external
+                        if kid not in written:
+                            er_seen.add(kid)
+                        continue
+                    check_val(v)
+                    rd_txn.append(t.i)
+                    rd_key.append(kid)
+                    rd_val.append(v)
+                    if kid in expected and expected[kid] != v:
+                        internal_bad.append(
+                            {"key": k, "expected": expected[kid],
+                             "read": v, "op": t.op})
+                    expected[kid] = v
+                    last_read[kid] = v
+                    if kid not in written and kid not in er_seen:
+                        er_seen.add(kid)
+                        er_txn.append(t.i)
+                        er_key.append(kid)
+                        er_val.append(v)
+            # non-final writes per key (txn.clj: intermediates)
+            for rows in per_key_rows.values():
+                wr_nonfinal.extend(rows[:-1])
+        if len(key_ids) >= (1 << _KEY_BITS):
+            raise Unvectorizable("too many keys for pair packing")
+        self.key_names = list(key_ids)
+        self.wr_txn = np.asarray(wr_txn, dtype=np.int64)
+        self.wr_key = np.asarray(wr_key, dtype=np.int64)
+        self.wr_val = np.asarray(wr_val, dtype=np.int64)
+        self.wr_nonfinal = np.asarray(wr_nonfinal, dtype=np.int64)
+        self.rd_txn = np.asarray(rd_txn, dtype=np.int64)
+        self.rd_key = np.asarray(rd_key, dtype=np.int64)
+        self.rd_val = np.asarray(rd_val, dtype=np.int64)
+        self.fr_txn = np.asarray(fr_txn, dtype=np.int64)
+        self.fr_key = np.asarray(fr_key, dtype=np.int64)
+        self.fr_prev = np.asarray(fr_prev, dtype=np.int64)
+        self.fr_new = np.asarray(fr_new, dtype=np.int64)
+        self.er_txn = np.asarray(er_txn, dtype=np.int64)
+        self.er_key = np.asarray(er_key, dtype=np.int64)
+        self.er_val = np.asarray(er_val, dtype=np.int64)
+        self.internal_bad = internal_bad
+
+
+class DeviceRwAnalysis:
+    """Vectorized analog of elle.check_rw_register's per-txn dict
+    passes: writer resolution, duplicate/aborted/intermediate read
+    anomalies, and wr/ww/rw edge inference as packed-array lookups.
+    Witness payloads for flagged rows are extracted host-side, capped
+    at the same 8 the result slice keeps."""
+
+    CAP = 8
+
+    def __init__(self, hist: History, device: bool = True):
+        self.txns = collect(hist)
+        self.device = device
+        self.anomalies: dict[str, list] = defaultdict(list)
+        f = self.flat = RwFlat(self.txns)
+        for rec in f.internal_bad:
+            self.anomalies["internal"].append(rec)
+        self._resolve_writers()
+        self._read_anomalies_and_edges()
+
+    def _resolve_writers(self):
+        f = self.flat
+        W = len(f.wr_txn)
+        codes = np.unique(_pack(f.wr_key, f.wr_val)) if W else \
+            np.empty(0, dtype=np.int64)
+        self.pair_codes = codes
+        P = len(codes)
+        inv = (np.searchsorted(codes, _pack(f.wr_key, f.wr_val))
+               if W else np.empty(0, dtype=np.int64))
+        order = np.arange(W)
+        nonfail = f.t_type[f.wr_txn] != _TYPE_FAIL if W else \
+            np.empty(0, dtype=bool)
+        # writer row per pair: last non-fail write, else first write
+        # (the host's writer-dict overwrite rule)
+        last_nf = np.full(P, -1, dtype=np.int64)
+        first_any = np.full(P, W, dtype=np.int64)
+        if W:
+            np.maximum.at(last_nf, inv[nonfail], order[nonfail])
+            np.minimum.at(first_any, inv, order)
+        w_row = np.where(last_nf >= 0, last_nf, first_any)
+        self.w_txn = (f.wr_txn[np.clip(w_row, 0, max(W - 1, 0))]
+                      if W else np.empty(0, dtype=np.int64))
+        self.w_fail = (f.t_type[self.w_txn] == _TYPE_FAIL
+                       if W else np.empty(0, dtype=bool))
+        # duplicate-writes: non-fail writes beyond their pair's first
+        # non-fail (host flags when the standing writer is non-fail)
+        if W:
+            sub = np.flatnonzero(nonfail)
+            if sub.size:
+                srt = sub[np.argsort(inv[sub], kind="stable")]
+                pid_s = inv[srt]
+                first = np.ones(srt.size, dtype=bool)
+                first[1:] = pid_s[1:] != pid_s[:-1]
+                for row in srt[~first][:self.CAP]:
+                    t = self.txns[f.wr_txn[row]]
+                    self.anomalies["duplicate-writes"].append({
+                        "key": f.key_names[f.wr_key[row]],
+                        "value": int(f.wr_val[row]), "op": t.op})
+        # intermediate (non-final) writer per pair: last row in txn
+        # order wins, like the host's dict overwrite
+        self.inter_txn = np.full(P, -1, dtype=np.int64)
+        if len(f.wr_nonfinal):
+            rows = f.wr_nonfinal
+            pids = inv[rows]
+            np.maximum.at(self.inter_txn, pids, rows)
+            got = self.inter_txn >= 0
+            self.inter_txn[got] = f.wr_txn[self.inter_txn[got]]
+
+    def _pid_of(self, keys, vals) -> np.ndarray:
+        codes = _pack(np.asarray(keys, dtype=np.int64),
+                      np.asarray(vals, dtype=np.int64))
+        if len(self.pair_codes) == 0:
+            return np.full(len(codes), -1, dtype=np.int64)
+        pos = np.searchsorted(self.pair_codes, codes)
+        pos = np.clip(pos, 0, len(self.pair_codes) - 1)
+        return np.where(self.pair_codes[pos] == codes, pos, -1)
+
+    def _read_anomalies_and_edges(self):
+        f = self.flat
+        src: list = []
+        dst: list = []
+        ty: list = []
+
+        def emit(s, d, t):
+            src.append(np.asarray(s, dtype=np.int64))
+            dst.append(np.asarray(d, dtype=np.int64))
+            ty.append(np.full(len(s), t, dtype=np.int64))
+
+        # -- reads: unobservable / G1a / G1b + wr edges
+        if len(f.rd_txn):
+            pid = self._pid_of(f.rd_key, f.rd_val)
+            missing = pid == -1
+            for i in np.flatnonzero(missing)[:self.CAP]:
+                self.anomalies["unobservable-read"].append({
+                    "key": f.key_names[f.rd_key[i]],
+                    "value": int(f.rd_val[i]),
+                    "op": self.txns[f.rd_txn[i]].op})
+            found = ~missing
+            if len(self.pair_codes):
+                wt = np.where(found,
+                              self.w_txn[np.clip(pid, 0, None)], -1)
+                wfail = np.where(
+                    found, self.w_fail[np.clip(pid, 0, None)], False)
+            else:  # reads but not a single write anywhere
+                wt = np.full(len(f.rd_txn), -1, dtype=np.int64)
+                wfail = np.zeros(len(f.rd_txn), dtype=bool)
+            g1a = found & wfail
+            for i in np.flatnonzero(g1a)[:self.CAP]:
+                self.anomalies["G1a"].append({
+                    "key": f.key_names[f.rd_key[i]],
+                    "value": int(f.rd_val[i]),
+                    "op": self.txns[f.rd_txn[i]].op,
+                    "writer": self.txns[wt[i]].op})
+            ext = found & ~wfail & (wt != f.rd_txn)
+            inter = np.where(found,
+                             self.inter_txn[np.clip(pid, 0, None)], -1)
+            g1b = ext & (inter >= 0) & (inter != f.rd_txn)
+            for i in np.flatnonzero(g1b)[:self.CAP]:
+                self.anomalies["G1b"].append({
+                    "key": f.key_names[f.rd_key[i]],
+                    "value": int(f.rd_val[i]),
+                    "op": self.txns[f.rd_txn[i]].op,
+                    "writer": self.txns[inter[i]].op})
+            emit(wt[ext], f.rd_txn[ext], WR)
+
+        # -- write-follows-read: ww edges + version succession
+        if len(f.fr_txn):
+            pw_pid = self._pid_of(f.fr_key, f.fr_prev)
+            ok = pw_pid >= 0
+            pw = np.where(ok, self.w_txn[np.clip(pw_pid, 0, None)], -1)
+            m = ok & (pw >= 0) & (pw != f.fr_txn)
+            emit(pw[m], f.fr_txn[m], WW)
+            # succ[(k, prev)] = new, last in txn order wins
+            fp = _pack(f.fr_key, f.fr_prev)
+            order = np.argsort(fp, kind="stable")
+            fp_s = fp[order]
+            last = np.ones(len(fp_s), dtype=bool)
+            last[:-1] = fp_s[1:] != fp_s[:-1]
+            self.succ_codes = fp_s[last]
+            self.succ_vals = f.fr_new[order][last]
+        else:
+            self.succ_codes = np.empty(0, dtype=np.int64)
+            self.succ_vals = np.empty(0, dtype=np.int64)
+
+        # -- external reads -> rw edges against the proven successor
+        if len(f.er_txn) and len(self.succ_codes):
+            ec = _pack(f.er_key, f.er_val)
+            pos = np.searchsorted(self.succ_codes, ec)
+            pos = np.clip(pos, 0, len(self.succ_codes) - 1)
+            has = self.succ_codes[pos] == ec
+            nv = np.where(has, self.succ_vals[pos], 0)
+            w2_pid = self._pid_of(f.er_key, nv)
+            w2_ok = has & (w2_pid >= 0)
+            w2 = np.where(w2_ok,
+                          self.w_txn[np.clip(w2_pid, 0, None)], -1)
+            m = (w2_ok & (w2 >= 0) & (w2 != f.er_txn)
+                 & (f.t_type[np.clip(w2, 0, None)] == _TYPE_OK))
+            emit(f.er_txn[m], w2[m], RW)
+
+        committed = [t for t in self.txns if t.type == h.OK]
+        o_src, o_dst, o_ty = order_edge_arrays(committed)
+        src.append(o_src)
+        dst.append(o_dst)
+        ty.append(o_ty)
+        self.edge_src = np.concatenate(src) if src else \
+            np.empty(0, dtype=np.int64)
+        self.edge_dst = np.concatenate(dst) if dst else \
+            np.empty(0, dtype=np.int64)
+        self.edge_ty = np.concatenate(ty) if ty else \
+            np.empty(0, dtype=np.int64)
+
+
+def check_rw_register_device(hist, device: bool = True) -> dict:
+    """Drop-in device-path analog of elle.check_rw_register. Raises
+    Unvectorizable when the history can't be interned."""
+    if not isinstance(hist, History):
+        hist = History(hist)
+    a = DeviceRwAnalysis(hist, device=device)
+    anomalies = dict(a.anomalies)
+    for name, ws in cycle_anomalies_arrays(
+            len(a.txns), a.edge_src, a.edge_dst, a.edge_ty, a.txns,
+            device=device).items():
+        anomalies[name] = ws
+    return {
+        "valid?": not anomalies,
+        "anomaly-types": sorted(anomalies.keys()),
+        "anomalies": {k: v[:8] for k, v in anomalies.items()},
+        "edge-count": int(len(a.edge_src)),
+        "txn-count": len(a.txns),
+    }

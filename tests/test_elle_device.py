@@ -285,3 +285,36 @@ class TestRwRegisterDeviceDispatch:
         rd = elle.check_rw_register(hist, {"engine": "device"})
         rh = elle.check_rw_register(hist, {"engine": "host"})
         assert rd["valid?"] is rh["valid?"] is True
+
+
+def test_rw_none_first_read_not_promoted_to_external():
+    """A None first read is the key's external read (txn.clj ext-reads
+    semantics): a later valued read of the same key must not emit the
+    rw edge the host engine never produces (review r3)."""
+    hist = T(
+        ("invoke", 0, [["w", "x", 1]]), ("ok", 0, [["w", "x", 1]]),
+        ("invoke", 1, [["w", "x", 2]]), ("ok", 1, [["w", "x", 2]]),
+        # reads None first, then 1, in one txn; succ[(x,1)]=2 exists
+        # via t2's write-follows-read
+        ("invoke", 2, [["r", "x", None], ["r", "x", None]]),
+        ("ok", 2, [["r", "x", None], ["r", "x", 1]]),
+        ("invoke", 3, [["r", "x", None], ["w", "x", 3]]),
+        ("ok", 3, [["r", "x", 1], ["w", "x", 3]]))
+    rh = elle.check_rw_register(hist, {"engine": "host"})
+    rd = elle.check_rw_register(hist, {"engine": "device"})
+    assert rd["valid?"] == rh["valid?"]
+    assert rd["anomaly-types"] == rh["anomaly-types"]
+    assert rd["edge-count"] == rh["edge-count"]
+
+
+def test_rw_unvectorizable_values_still_check():
+    """String register values can't intern; engine=device must fall
+    back to host inference + device SCC and agree with host."""
+    hist = T(
+        ("invoke", 0, [["w", "x", "a"]]),
+        ("ok", 0, [["w", "x", "a"]]),
+        ("invoke", 1, [["r", "x", None]]),
+        ("ok", 1, [["r", "x", "a"]]))
+    rd = elle.check_rw_register(hist, {"engine": "device"})
+    rh = elle.check_rw_register(hist, {"engine": "host"})
+    assert rd["valid?"] is rh["valid?"] is True
