@@ -1,0 +1,235 @@
+"""Galera suite tests: cluster bootstrap command emission via the
+dummy remote, an in-memory mysql speaking the suite's SQL batches, and
+clusterless end-to-end bank/set runs (mirrors
+galera/src/jepsen/galera.clj)."""
+
+import re
+import threading
+
+from jepsen_tpu import control, core, testing
+from jepsen_tpu import generator as gen
+from jepsen_tpu.control.core import Action, RemoteError
+from jepsen_tpu.control.dummy import DummyRemote
+from jepsen_tpu.history import Op
+from jepsen_tpu.suites import galera as gal
+
+
+def make_test(nodes=("n1", "n2", "n3")):
+    remote = DummyRemote()
+    t = testing.noop_test()
+    t.update(nodes=list(nodes), remote=remote,
+             sessions={n: remote.connect({"host": n}) for n in nodes})
+    return core.prepare_test(t)
+
+
+def cmds(test, node):
+    return [a.cmd for a in test["sessions"][node].log
+            if isinstance(a, Action)]
+
+
+class TestDB:
+    def test_bootstrap_flow(self):
+        test = make_test()
+        db = gal.GaleraDB()
+        control.on_nodes(test, lambda t, n: db.setup(t, n))
+        got1 = " ; ".join(cmds(test, "n1"))
+        got2 = " ; ".join(cmds(test, "n2"))
+        # only the primary bootstraps the new cluster
+        assert "--wsrep-new-cluster" in got1
+        assert "--wsrep-new-cluster" not in got2
+        assert "service mysql start" in got2
+        # debconf preseed + stock-dir stash on every node
+        for got in (got1, got2):
+            assert "debconf-set-selections" in got
+            assert "mariadb-galera-server" in got
+            assert "/var/lib/mysql-stock" in got
+        # cluster address lists every node
+        acts = [a for a in test["sessions"]["n2"].log
+                if isinstance(a, Action) and a.stdin]
+        cnf = next(a.stdin for a in acts if "jepsen.cnf" in a.cmd)
+        assert "gcomm://n1,n2,n3" in cnf
+        # accounts seeded once, on the primary
+        assert "INSERT IGNORE INTO jepsen.accounts" in got1
+        assert "INSERT IGNORE" not in got2
+
+    def test_teardown_restores_stock(self):
+        test = make_test()
+        db = gal.GaleraDB()
+        with control.with_session(test, "n1"):
+            db.teardown(test, "n1")
+        got = " ; ".join(cmds(test, "n1"))
+        assert "rm -rf /var/lib/mysql" in got
+        assert "cp -rp /var/lib/mysql-stock /var/lib/mysql" in got
+
+
+class FakeMysql:
+    """Executes the suite's SQL batches atomically under one lock — a
+    perfectly consistent single 'cluster'."""
+
+    def __init__(self, accounts=8, balance=10):
+        self.lock = threading.Lock()
+        self.accounts = {i: balance for i in range(accounts)}
+        self.sets: list = []
+
+    def run(self, sql: str) -> str:
+        with self.lock:
+            if "CONCAT('b='" in sql:
+                return "b=" + ",".join(
+                    f"{i}:{b}" for i, b in sorted(self.accounts.items()))
+            if "START TRANSACTION" in sql:
+                f = int(re.search(r"WHERE id = (\d+);", sql).group(1))
+                m = re.search(
+                    r"balance - (\d+) WHERE id = (\d+)", sql)
+                a, f2 = int(m.group(1)), int(m.group(2))
+                t = int(re.search(
+                    r"balance \+ \d+ WHERE id = (\d+)", sql).group(1))
+                assert f == f2
+                if self.accounts[f] >= a:
+                    self.accounts[f] -= a
+                    self.accounts[t] += a
+                    return "applied=1"
+                return "applied=0"
+            if "INSERT INTO sets" in sql:
+                self.sets.append(int(
+                    re.search(r"VALUES \((\d+)\)", sql).group(1)))
+                return ""
+            if "CONCAT('s='" in sql:
+                return "s=" + ",".join(map(str, self.sets))
+            raise AssertionError(f"fake mysql can't parse: {sql!r}")
+
+
+class FakeMysqlFactory:
+    def __init__(self, state=None):
+        self.state = state or FakeMysql()
+
+    def __call__(self, test, node, timeout=10.0):
+        factory = self
+
+        class _M:
+            def run(self, sql):
+                return factory.state.run(sql)
+
+            def close(self):
+                pass
+
+        return _M()
+
+
+class TestEndToEnd:
+    def _run(self, workload_fn, opts, factory):
+        w = workload_fn(opts)
+        w["client"].mysql_factory = factory
+        test = testing.noop_test()
+        test.update(nodes=["n1", "n2"],
+                    concurrency=opts.get("concurrency", 4),
+                    client=w["client"], checker=w["checker"],
+                    generator=gen.clients(
+                        gen.stagger(0.0005, gen.limit(
+                            opts.get("ops", 200), w["generator"]))))
+        return core.run(test)
+
+    def test_bank_conserves_total(self):
+        test = self._run(gal.bank_workload,
+                         {"seed": 5, "ops": 200}, FakeMysqlFactory())
+        assert test["results"]["valid?"] is True
+        reads = [op for op in test["history"]
+                 if op.type == "ok" and op.f == "read"]
+        assert reads and all(sum(op.value.values()) == 80
+                             for op in reads)
+        # with amounts up to 5 against 10-unit accounts, some
+        # transfer hits insufficient funds over 200 ops (seeded)
+        assert any(op.type == "fail" and op.f == "transfer"
+                   for op in test["history"])
+
+    def test_bank_detects_lost_credit(self):
+        class Lossy(FakeMysql):
+            def __init__(self):
+                super().__init__()
+                self.n = 0
+
+            def run(self, sql):
+                if "START TRANSACTION" in sql:
+                    self.n += 1
+                    if self.n % 5 == 0:
+                        # debit applies, credit lost: shrinking total
+                        m = re.search(
+                            r"balance - (\d+) WHERE id = (\d+)", sql)
+                        a, f = int(m.group(1)), int(m.group(2))
+                        with self.lock:
+                            if self.accounts[f] >= a:
+                                self.accounts[f] -= a
+                                return "applied=1"
+                            return "applied=0"
+                return super().run(sql)
+
+        test = self._run(gal.bank_workload, {"seed": 7, "ops": 200},
+                         FakeMysqlFactory(Lossy()))
+        assert test["results"]["valid?"] is False
+
+    def test_set_workload(self):
+        gen_opts = {"ops": 100, "concurrency": 4}
+        w = gal.set_workload(gen_opts)
+        w["client"].mysql_factory = FakeMysqlFactory()
+        test = testing.noop_test()
+        test.update(nodes=["n1"], concurrency=4,
+                    client=w["client"], checker=w["checker"],
+                    generator=gen.clients(gen.phases(
+                        gen.stagger(0.0003, w["generator"]),
+                        w["final_generator"])))
+        test = core.run(test)
+        assert test["results"]["valid?"] is True
+
+    def test_set_detects_lost_insert(self):
+        class Dropping(FakeMysql):
+            def __init__(self):
+                super().__init__()
+                self.n = 0
+
+            def run(self, sql):
+                if "INSERT INTO sets" in sql:
+                    self.n += 1
+                    if self.n == 3:
+                        return ""  # ack but drop
+                return super().run(sql)
+
+        w = gal.set_workload({"ops": 60})
+        w["client"].mysql_factory = FakeMysqlFactory(Dropping())
+        test = testing.noop_test()
+        test.update(nodes=["n1"], concurrency=2,
+                    client=w["client"], checker=w["checker"],
+                    generator=gen.clients(gen.phases(
+                        gen.stagger(0.0003, w["generator"]),
+                        w["final_generator"])))
+        test = core.run(test)
+        assert test["results"]["valid?"] is False
+        assert test["results"]["lost"]
+
+
+class TestClientErrors:
+    def test_deadlock_is_definite_fail(self):
+        class Deadlocking:
+            def __call__(self, test, node, timeout=10.0):
+                class _M:
+                    def run(self, sql):
+                        raise RemoteError(
+                            "mysql failed", exit=1, out="",
+                            err="ERROR 1213 (40001): Deadlock found",
+                            cmd="mysql", node=node)
+
+                    def close(self):
+                        pass
+
+                return _M()
+
+        c = gal.GaleraBankClient(mysql_factory=Deadlocking()).open(
+            {"nodes": ["n1"]}, "n1")
+        op = Op(type="invoke", process=0, f="transfer",
+                value={"from": 0, "to": 1, "amount": 3})
+        assert c.invoke({}, op).type == "fail"
+
+    def test_cli_map(self):
+        opts = {"nodes": ["n1", "n2", "n3"], "concurrency": 3,
+                "ssh": {"dummy": True}, "time_limit": 5}
+        test = gal.galera_test(opts)
+        assert test["name"] == "galera-bank"
+        assert isinstance(test["db"], gal.GaleraDB)
