@@ -23,6 +23,8 @@ CLIENTS = {
     "counter": lambda: testing.CounterClient(),
     "unique-ids": lambda: testing.UniqueIdsClient(),
     "long-fork": lambda: testing.TxnClient(),
+    "monotonic": lambda: testing.MonotonicClient(),
+    "sequential": lambda: testing.SequentialClient(),
     "append": lambda: testing.TxnClient(),
     "wr": lambda: testing.TxnClient(),
     "kafka": lambda: testing.KafkaClient(),
@@ -44,6 +46,14 @@ def _workload_opts(name: str, opts: dict) -> dict:
                       "ops_per_key": ops // 8 or 1})
     elif name == "causal-reverse":
         wopts.update({"per-key-limit": ops // 4 or 1})
+    elif name == "sequential":
+        # reserve() would otherwise hand every thread to the writers
+        # at low concurrency, leaving zero readers (valid? unknown);
+        # at concurrency 1 there's no split that works — the single
+        # thread writes, and the checker reports unknown honestly
+        writers = min(max(1, opts["concurrency"] // 2),
+                      max(opts["concurrency"] - 1, 1))
+        wopts.update({"writers": writers})
     return wopts
 
 
@@ -75,14 +85,24 @@ def make_test(opts: dict) -> dict:
                              "stats": chk.stats(),
                              "perf": chk.perf(),
                              "timeline": chk.timeline()}),
-        generator=gen.clients(
-            gen.time_limit(opts.get("time_limit", 60),
-                           gen.stagger(1.0 / opts.get("rate", 100),
-                                       w["generator"]))))
+        generator=_generator(opts, w))
     for k, v in w.items():
-        if k not in ("generator", "checker"):
+        if k not in ("generator", "checker", "final_generator"):
             test[k] = v
     return test
+
+
+def _generator(opts: dict, w: dict):
+    main = gen.clients(
+        gen.time_limit(opts.get("time_limit", 60),
+                       gen.stagger(1.0 / opts.get("rate", 100),
+                                   w["generator"])))
+    final = w.get("final_generator")
+    if final is None:
+        return main
+    # a workload's final phase (e.g. monotonic's reads) runs after the
+    # time limit, like the suites' heal-then-read shape
+    return gen.phases(main, gen.clients(final))
 
 
 def make_all_tests(opts: dict):

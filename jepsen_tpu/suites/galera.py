@@ -192,6 +192,10 @@ class GaleraBankClient(jclient.Client):
         try:
             if op.f == "read":
                 out = self.mysql.run(
+                    # same 1024-byte GROUP_CONCAT truncation guard as
+                    # the set client: wide account tables must not be
+                    # silently cut into a false loss verdict
+                    "SET SESSION group_concat_max_len = 1048576; "
                     "SELECT CONCAT('b=', COALESCE(GROUP_CONCAT("
                     "CONCAT(id, ':', balance) ORDER BY id), '')) "
                     "FROM accounts;")

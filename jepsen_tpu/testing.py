@@ -500,3 +500,110 @@ class KafkaClient(jclient.Client):
                     self.positions[k] = len(log)
                 done.append(["poll", reads])
         return o.copy(type="ok", value=done)
+
+
+class MonotonicState:
+    """Rows for the monotonic workload, with a perfect (or skewed)
+    logical clock."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.rows: list = []
+        self.clock = 0
+
+
+class MonotonicClient(jclient.Client):
+    """In-memory monotonic-inserts client (mirrors cockroach
+    monotonic.clj semantics): add reads the max, inserts max+1 with a
+    db timestamp; read returns rows sorted by timestamp.
+    `skew_every` makes every Nth timestamp run backwards (an ordering
+    violation); `dup_every` re-inserts an existing value."""
+
+    def __init__(self, state=None, skew_every: int = 0,
+                 dup_every: int = 0, node_index: int = 0):
+        self.state = state if state is not None else MonotonicState()
+        self.skew_every = skew_every
+        self.dup_every = dup_every
+        self.node_index = node_index
+
+    def open(self, test, node):
+        idx = list(test.get("nodes", ())).index(node) \
+            if node in test.get("nodes", ()) else 0
+        return MonotonicClient(self.state, self.skew_every,
+                               self.dup_every, idx)
+
+    def invoke(self, test, op):
+        s = self.state
+        with s.lock:
+            if op.f == "add":
+                cur_max = max((r["val"] for r in s.rows), default=0)
+                val = cur_max + 1
+                if self.dup_every and len(s.rows) and \
+                        len(s.rows) % self.dup_every == 0:
+                    val = s.rows[-1]["val"]  # duplicate insert
+                s.clock += 1
+                sts = s.clock
+                if self.skew_every and \
+                        len(s.rows) % self.skew_every == (
+                            self.skew_every - 1):
+                    sts = max(s.clock - 3, 0)  # clock ran backwards
+                row = {"val": val, "sts": sts,
+                       "node": self.node_index,
+                       "process": op.process,
+                       "tb": len(s.rows) % 2}
+                s.rows.append(row)
+                return op.copy(type="ok", value=row)
+            if op.f == "read":
+                rows = sorted(s.rows, key=lambda r: r["sts"])
+                return op.copy(type="ok", value=rows)
+        raise ValueError(f"unknown f {op.f!r}")
+
+
+class SequentialState:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.present: set = set()
+
+
+class SequentialClient(jclient.Client):
+    """In-memory sequential-consistency client: writes insert a key's
+    subkeys in order (each its own 'txn'); reads probe them reversed.
+    `hide_first_every` makes every Nth write skip its FIRST subkey (a
+    later subkey visible without the earlier one -> violation)."""
+
+    def __init__(self, state=None, key_count: int = 5,
+                 hide_first_every: int = 0):
+        self.state = state if state is not None else SequentialState()
+        self.key_count = key_count
+        self.hide_first_every = hide_first_every
+        self._writes = 0
+
+    def open(self, test, node):
+        c = SequentialClient(self.state,
+                             test.get("key_count", self.key_count),
+                             self.hide_first_every)
+        return c
+
+    def invoke(self, test, op):
+        from .workloads import sequential as seq
+
+        s = self.state
+        ks = seq.subkeys(self.key_count, op.value)
+        if op.f == "write":
+            self._writes += 1
+            skip_first = (self.hide_first_every
+                          and self._writes % self.hide_first_every
+                          == 0)
+            for i, k in enumerate(ks):
+                if skip_first and i == 0:
+                    continue
+                with s.lock:
+                    s.present.add(k)
+            return op.copy(type="ok")
+        if op.f == "read":
+            obs = []
+            for k in reversed(ks):
+                with s.lock:
+                    obs.append(k if k in s.present else None)
+            return op.copy(type="ok", value=(op.value, obs))
+        raise ValueError(f"unknown f {op.f!r}")

@@ -12,8 +12,10 @@ from . import causal_reverse  # noqa: F401
 from . import counter  # noqa: F401
 from . import kafka  # noqa: F401
 from . import long_fork  # noqa: F401
+from . import monotonic  # noqa: F401
 from . import queue  # noqa: F401
 from . import register  # noqa: F401
+from . import sequential  # noqa: F401
 from . import sets  # noqa: F401
 from . import txn_append  # noqa: F401
 from . import txn_wr  # noqa: F401
@@ -27,8 +29,10 @@ REGISTRY = {
     "counter": counter.workload,
     "kafka": kafka.workload,
     "long-fork": long_fork.workload,
+    "monotonic": monotonic.workload,
     "queue": queue.workload,
     "register": register.workload,
+    "sequential": sequential.workload,
     "set": sets.workload,
     "set-full": sets.full_workload,
     "append": txn_append.workload,

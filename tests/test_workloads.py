@@ -163,7 +163,7 @@ class TestWorkloadsEndToEnd:
     def test_registry_complete(self):
         assert set(workloads.REGISTRY) == {
             "adya-g2", "bank", "causal", "causal-reverse", "counter",
-            "kafka", "long-fork", "queue", "register", "set",
+            "kafka", "long-fork", "monotonic", "sequential", "queue", "register", "set",
             "set-full", "append", "wr", "unique-ids"}
 
 
@@ -393,3 +393,119 @@ class TestSetFullEdgeCases:
             assert a["outcome"] == b["outcome"] == "lost"
             assert a["known"] is b["known"]          # the read's ok op
             assert a["last-absent"] is b["last-absent"]
+
+
+class TestMonotonic:
+    """cockroach monotonic.clj equivalents."""
+
+    def _run(self, client, ops=120, concurrency=4):
+        from jepsen_tpu import workloads
+
+        w = workloads.monotonic.workload({"ops": ops})
+        test = testing.noop_test()
+        test.update(nodes=["n1", "n2"], concurrency=concurrency,
+                    client=client, checker=w["checker"],
+                    generator=gen.clients(gen.phases(
+                        gen.stagger(0.0003, w["generator"]),
+                        w["final_generator"])))
+        return core.run(test)
+
+    def test_healthy_run_valid(self):
+        test = self._run(testing.MonotonicClient())
+        res = test["results"]
+        assert res["valid?"] is True
+        assert res["add-count"] > 50 and res["read-count"] > 50
+        assert not res["lost"] and not res["duplicates"]
+
+    def test_clock_skew_detected(self):
+        test = self._run(testing.MonotonicClient(skew_every=10))
+        res = test["results"]
+        assert res["valid?"] is False
+        assert res["order-by-errors"]
+
+    def test_duplicate_insert_detected(self):
+        test = self._run(testing.MonotonicClient(dup_every=15))
+        res = test["results"]
+        assert res["valid?"] is False
+        assert res["duplicates"]
+
+    def test_never_read_is_unknown(self):
+        from jepsen_tpu import workloads
+
+        w = workloads.monotonic.workload({"ops": 20})
+        test = testing.noop_test()
+        test.update(nodes=["n1"], concurrency=2,
+                    client=testing.MonotonicClient(),
+                    checker=w["checker"],
+                    generator=gen.clients(
+                        gen.stagger(0.0003, w["generator"])))
+        test = core.run(test)
+        assert test["results"]["valid?"] == "unknown"
+
+
+class TestSequential:
+    """cockroach sequential.clj equivalents."""
+
+    def _run(self, client, ops=200, concurrency=6):
+        from jepsen_tpu import workloads
+
+        w = workloads.sequential.workload({"ops": ops, "writers": 3,
+                                           "seed": 11})
+        test = testing.noop_test()
+        test.update(nodes=["n1"], concurrency=concurrency,
+                    client=client, key_count=w["key_count"],
+                    checker=w["checker"],
+                    generator=gen.clients(
+                        gen.stagger(0.0003, w["generator"])))
+        return core.run(test)
+
+    def test_healthy_run_valid(self):
+        test = self._run(testing.SequentialClient())
+        res = test["results"]
+        assert res["valid?"] is True
+        assert res["bad-count"] == 0
+        assert res["all-count"] + res["some-count"] + \
+            res["none-count"] >= res["all-count"]
+        reads = [op for op in test["history"]
+                 if op.type == "ok" and op.f == "read"]
+        assert reads
+
+    def test_trailing_none_detected(self):
+        """Writers that skip a key's first subkey leave later subkeys
+        visible without it: sequential consistency violation."""
+        test = self._run(
+            testing.SequentialClient(hide_first_every=2), ops=300)
+        res = test["results"]
+        assert res["valid?"] is False
+        assert res["bad-count"] > 0
+
+    def test_subkeys_order(self):
+        from jepsen_tpu.workloads import sequential as seq
+
+        assert seq.subkeys(3, 7) == ["7_0", "7_1", "7_2"]
+        assert seq._trailing_none(["7_2", None]) is True
+        assert seq._trailing_none([None, "7_1"]) is False
+        assert seq._trailing_none([None, None]) is False
+
+    def test_store_roundtrip_preserves_reads(self, tmp_path,
+                                             monkeypatch):
+        """A NAMED test round-trips its history through the JSON store
+        log (tuples become lists); the checker must still see the
+        reads (regression: valid? was 'unknown' from the CLI)."""
+        import jepsen_tpu.store as store_mod
+        from jepsen_tpu import workloads
+
+        monkeypatch.setattr(store_mod, "BASE", tmp_path / "store")
+        w = workloads.sequential.workload({"ops": 100, "writers": 2,
+                                           "seed": 3})
+        test = testing.noop_test()
+        test.update(name="seq-store", nodes=["n1"], concurrency=4,
+                    client=testing.SequentialClient(),
+                    key_count=w["key_count"], checker=w["checker"],
+                    generator=gen.clients(
+                        gen.stagger(0.0003, w["generator"])))
+        test = core.run(test)
+        res = test["results"]
+        assert res["valid?"] is True
+        assert res["all-count"] + res["some-count"] + \
+            res["none-count"] > 0
