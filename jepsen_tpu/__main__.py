@@ -47,13 +47,10 @@ def _workload_opts(name: str, opts: dict) -> dict:
     elif name == "causal-reverse":
         wopts.update({"per-key-limit": ops // 4 or 1})
     elif name == "sequential":
-        # reserve() would otherwise hand every thread to the writers
-        # at low concurrency, leaving zero readers (valid? unknown);
-        # at concurrency 1 there's no split that works — the single
-        # thread writes, and the checker reports unknown honestly
-        writers = min(max(1, opts["concurrency"] // 2),
-                      max(opts["concurrency"] - 1, 1))
-        wopts.update({"writers": writers})
+        # reserve() would otherwise hand every thread to the writers,
+        # leaving zero readers (valid? unknown)
+        wopts.update({"writers": workloads.sequential.default_writers(
+            opts["concurrency"])})
     return wopts
 
 

@@ -23,6 +23,13 @@ from .. import checker as chk
 from .. import generator as gen
 
 
+def default_writers(concurrency: int) -> int:
+    """Half the threads write, but never ALL of them (a reader pool
+    must exist for the checker to have coverage); at concurrency 1
+    the single thread writes and the checker reports unknown."""
+    return min(max(1, concurrency // 2), max(concurrency - 1, 1))
+
+
 def subkeys(key_count: int, k) -> list:
     """The subkeys of k, in write order (sequential.clj:46-49)."""
     return [f"{k}_{i}" for i in range(key_count)]

@@ -1,0 +1,265 @@
+"""Cockroach suite tests: cluster init command emission via the dummy
+remote, an in-memory cockroach speaking the suite's SQL shapes, and
+clusterless end-to-end runs of all four workloads (mirrors
+cockroachdb/src/jepsen/cockroach/*.clj)."""
+
+import re
+import threading
+from decimal import Decimal
+
+from jepsen_tpu import control, core, independent, testing
+from jepsen_tpu import generator as gen
+from jepsen_tpu.control.core import Action, Result
+from jepsen_tpu.control.dummy import DummyRemote
+from jepsen_tpu.suites import cockroach as crdb
+
+
+def responder(node, action):
+    if action.cmd.startswith("stat "):
+        return Result(exit=1, out="", err="no such file",
+                      cmd=action.cmd)
+    if action.cmd.startswith("dirname "):
+        return action.cmd.split()[-1].rsplit("/", 1)[0]
+    if action.cmd.startswith("ls -A"):
+        return "cockroach-v23.1.14.linux-amd64"
+    return None
+
+
+def make_test(nodes=("n1", "n2", "n3")):
+    remote = DummyRemote(responder)
+    t = testing.noop_test()
+    t.update(nodes=list(nodes), remote=remote,
+             sessions={n: remote.connect({"host": n}) for n in nodes})
+    return core.prepare_test(t)
+
+
+def cmds(test, node):
+    return [a.cmd for a in test["sessions"][node].log
+            if isinstance(a, Action)]
+
+
+class TestDB:
+    def test_setup_and_init_flow(self):
+        test = make_test()
+        db = crdb.CockroachDB()
+        control.on_nodes(test, lambda t, n: db.setup(t, n))
+        got1 = " ; ".join(cmds(test, "n1"))
+        got2 = " ; ".join(cmds(test, "n2"))
+        for got in (got1, got2):
+            assert "cockroach-v23.1.14.linux-amd64.tgz" in got
+            assert "--join n1:26257,n2:26257,n3:26257" in got
+            assert "--insecure" in got
+        # init + schema happen once, on the primary
+        assert "init --insecure" in got1
+        assert "init --insecure" not in got2
+        assert "CREATE DATABASE IF NOT EXISTS jepsen" in got1
+        assert "CHECK (balance >= 0)" in got1
+        assert "cluster" not in got2 or "CREATE" not in got2
+
+    def test_teardown(self):
+        test = make_test()
+        db = crdb.CockroachDB()
+        with control.with_session(test, "n1"):
+            db.teardown(test, "n1")
+        got = " ; ".join(cmds(test, "n1"))
+        assert "/var/lib/cockroach" in got
+
+
+class FakeCrdb:
+    """In-memory cockroach speaking the suite's SQL shapes in tsv,
+    atomically under one lock."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.kv: dict = {}
+        self.accounts = {i: 10 for i in range(8)}
+        self.mono: list = []
+        self.clock = 0
+        self.seq: set = set()
+
+    def run(self, sql: str) -> str:
+        with self.lock:
+            if sql.startswith("SELECT v FROM kv"):
+                k = int(re.search(r"k = (\d+)", sql).group(1))
+                if k in self.kv:
+                    return f"v\n{self.kv[k]}"
+                return "v"
+            if sql.startswith("UPSERT INTO kv"):
+                k, v = map(int, re.search(
+                    r"\((\d+), (\d+)\)", sql).groups())
+                self.kv[k] = v
+                return ""
+            if sql.startswith("UPDATE kv"):
+                m = re.search(r"SET v = (\d+) WHERE k = (\d+) "
+                              r"AND v = (\d+)", sql)
+                new, k, old = map(int, m.groups())
+                if self.kv.get(k) == old:
+                    self.kv[k] = new
+                    return f"v\n{new}"
+                return "v"
+            if sql.startswith("INSERT INTO mono"):
+                m = re.search(r"(\d+), (\d+), (\d+) FROM mono", sql)
+                node, proc, tb = map(int, m.groups())
+                val = max((r["val"] for r in self.mono), default=0) + 1
+                self.clock += 1
+                row = {"val": val, "sts": Decimal(self.clock),
+                       "node": node, "process": proc, "tb": tb}
+                self.mono.append(row)
+                return ("val\tsts\tnode\tprocess\ttb\n"
+                        f"{val}\t{self.clock}\t{node}\t{proc}\t{tb}")
+            if sql.startswith("SELECT val, sts"):
+                rows = sorted(self.mono, key=lambda r: r["sts"])
+                out = ["val\tsts\tnode\tprocess\ttb"]
+                for r in rows:
+                    out.append(f"{r['val']}\t{r['sts']}\t{r['node']}"
+                               f"\t{r['process']}\t{r['tb']}")
+                return "\n".join(out)
+            if sql.startswith("INSERT INTO seq"):
+                self.seq.add(re.search(r"'([^']+)'", sql).group(1))
+                return ""
+            if sql.startswith("SELECT key FROM seq"):
+                k = re.search(r"= '([^']+)'", sql).group(1)
+                return f"key\n{k}" if k in self.seq else "key"
+            if sql.startswith("SELECT id, balance"):
+                out = ["id\tbalance"]
+                for i, b in sorted(self.accounts.items()):
+                    out.append(f"{i}\t{b}")
+                return "\n".join(out)
+            if sql.startswith("BEGIN"):
+                m = re.search(r"balance - (\d+) WHERE id = (\d+)", sql)
+                a, f = int(m.group(1)), int(m.group(2))
+                t = int(re.search(
+                    r"balance \+ \d+ WHERE id = (\d+)", sql).group(1))
+                from jepsen_tpu.control.core import RemoteError
+
+                if self.accounts[f] < a:
+                    raise RemoteError(
+                        "cockroach sql failed", exit=1, out="",
+                        err='violates check constraint '
+                            '"accounts_balance_check"',
+                        cmd="cockroach", node="n1")
+                self.accounts[f] -= a
+                self.accounts[t] += a
+                return ""
+            raise AssertionError(f"fake crdb can't parse: {sql!r}")
+
+
+class FakeSqlFactory:
+    def __init__(self, state=None):
+        self.state = state or FakeCrdb()
+
+    def __call__(self, test, node, timeout=10.0):
+        factory = self
+
+        class _S:
+            def run(self, sql):
+                return factory.state.run(sql)
+
+            def close(self):
+                pass
+
+        return _S()
+
+
+def run_workload(workload_fn, opts, factory, final=False):
+    w = workload_fn(opts)
+    w["client"].sql_factory = factory
+    test = testing.noop_test()
+    phases = [gen.stagger(0.0004, gen.limit(opts.get("gen_ops", 200),
+                                            w["generator"]))
+              if not w.get("final_generator")
+              else gen.stagger(0.0004, w["generator"])]
+    if w.get("final_generator"):
+        phases.append(w["final_generator"])
+    test.update(nodes=["n1", "n2"],
+                concurrency=opts.get("concurrency", 6),
+                key_count=w.get("key_count", 5),
+                client=w["client"],
+                checker=w["checker"],
+                generator=gen.clients(gen.phases(*phases)))
+    return core.run(test)
+
+
+class TestEndToEnd:
+    def test_register_valid(self):
+        test = run_workload(
+            crdb.register_workload,
+            {"concurrency": 6, "keys": 2, "ops_per_key": 50,
+             "seed": 3}, FakeSqlFactory())
+        assert test["results"]["valid?"] is True
+
+    def test_bank_valid_and_check_guard(self):
+        test = run_workload(
+            crdb.bank_workload,
+            {"concurrency": 4, "seed": 5, "gen_ops": 150},
+            FakeSqlFactory())
+        assert test["results"]["valid?"] is True
+        # overdrafts come back as definite fails via the CHECK error
+        fails = [op for op in test["history"]
+                 if op.f == "transfer" and op.type == "fail"]
+        assert all("check constraint" in (op.error or "")
+                   for op in fails)
+
+    def test_monotonic_valid(self):
+        test = run_workload(
+            crdb.monotonic_workload,
+            {"concurrency": 4, "ops": 120}, FakeSqlFactory())
+        assert test["results"]["valid?"] is True
+        assert test["results"]["add-count"] > 30
+
+    def test_monotonic_detects_skew(self):
+        class Skewed(FakeCrdb):
+            def run(self, sql):
+                out = super().run(sql)
+                if sql.startswith("INSERT INTO mono") and \
+                        len(self.mono) % 7 == 0:
+                    # rewrite the stored timestamp backwards
+                    with self.lock:
+                        self.mono[-1]["sts"] = Decimal(
+                            max(self.clock - 5, 0))
+                return out
+
+        test = run_workload(
+            crdb.monotonic_workload,
+            {"concurrency": 4, "ops": 150}, FakeSqlFactory(Skewed()))
+        assert test["results"]["valid?"] is False
+
+    def test_sequential_valid(self):
+        test = run_workload(
+            crdb.sequential_workload,
+            {"concurrency": 6, "ops": 200, "seed": 9},
+            FakeSqlFactory())
+        assert test["results"]["valid?"] is True
+        assert test["results"]["bad-count"] == 0
+
+    def test_sequential_detects_reorder(self):
+        class Dropping(FakeCrdb):
+            """Hides _0 subkeys from reads while later ones exist."""
+
+            def run(self, sql):
+                if sql.startswith("SELECT key FROM seq") and \
+                        "_0'" in sql:
+                    return "key"
+                return super().run(sql)
+
+        test = run_workload(
+            crdb.sequential_workload,
+            {"concurrency": 6, "ops": 200, "seed": 9},
+            FakeSqlFactory(Dropping()))
+        assert test["results"]["valid?"] is False
+
+
+class TestCli:
+    def test_map_shape(self):
+        opts = {"nodes": ["n1", "n2", "n3"], "concurrency": 6,
+                "ssh": {"dummy": True}, "time_limit": 5}
+        test = crdb.cockroach_test(opts)
+        assert test["name"] == "cockroach-register"
+        assert isinstance(test["db"], crdb.CockroachDB)
+
+    def test_monotonic_final_phase_wired(self):
+        opts = {"nodes": ["n1"], "concurrency": 2,
+                "ssh": {"dummy": True}, "workload": "monotonic",
+                "time_limit": 5}
+        test = crdb.cockroach_test(opts)
+        assert test["name"] == "cockroach-monotonic"
