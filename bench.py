@@ -188,14 +188,14 @@ def bench_headline(n_events):
     # runs); report steady-state, note compile separately.
     t0 = time.time()
     enc = encode(models.cas_register(), hist)
-    wgl.check_segmented(enc, target_len=2048)
+    wgl.check_segmented(enc, target_len=8192)
     _log(f"config2: first check (incl. compile) {time.time() - t0:.2f}s")
 
     times = []
     for _ in range(3):
         t1 = time.time()
         enc = encode(models.cas_register(), hist)
-        res = wgl.check_segmented(enc, target_len=2048)
+        res = wgl.check_segmented(enc, target_len=8192)
         if res is None:
             res = {"valid?": bool(wgl.check_batch([enc])[0] == wgl.VALID)}
         times.append(time.time() - t1)

@@ -719,7 +719,8 @@ class _SegmentCheckpoint:
                 self.save_one(k, s, m)
 
 
-def check_segmented(enc: Encoded, target_len: int = 2048, W: int = 24,
+def check_segmented(enc: Encoded, target_len: int | None = None,
+                    W: int = 24,
                     F: int = 48, witness: bool = False,
                     prefix_screen: int = 96,
                     checkpoint_path=None,
@@ -743,16 +744,23 @@ def check_segmented(enc: Encoded, target_len: int = 2048, W: int = 24,
     checkers (per-key independent checks, composed checkers) sharing a
     store directory never fight over one file.
 
-    prefix_screen: before launching, each (segment, start-state) row is
-    screened by a cheap host search over the segment's first
-    ~prefix_screen entries ENDING AT A VALID CUT — a time-complete
-    sub-history, so reach(prefix) == 0 soundly proves reach(segment)
-    == 0 (an arbitrary entry-prefix would NOT be: a pending read may
-    observe a later write). Wrong start states die in the prefix, so
-    the device launch runs ~half the rows and tiny segments resolve
-    exactly on host with no device row at all."""
+    prefix_screen: before the main launch, each (segment, start-state)
+    row is screened over the segment's first ~prefix_screen entries
+    ENDING AT A VALID CUT — a time-complete sub-history, so
+    reach(prefix) == 0 soundly proves reach(segment) == 0 (an
+    arbitrary entry-prefix would NOT be: a pending read may observe a
+    later write). The screen itself is one batched device reach
+    launch over all prefix rows (a tiny kernel bucket); rare UNKNOWN
+    rows fall back to the exact host search. Wrong start states die
+    in the prefix, so the main launch runs ~half the rows."""
     if enc.n_states > 32:
         return None
+    if target_len is None:
+        # Adaptive: long segments amortize kernel latency best (the
+        # sweep puts ~8192 at the single-chip sweet spot), but small
+        # histories still need >= ~8 segments for the batch dimension
+        # (and for checkpointing) to exist at all
+        target_len = min(8192, max(256, enc.m // 8))
     vcuts = valid_cut_points(enc)
     cuts = segment_cuts(enc, target_len, vcuts=vcuts)
     K = len(cuts) - 1
@@ -781,28 +789,49 @@ def check_segmented(enc: Encoded, target_len: int = 2048, W: int = 24,
         resolved.update(ckpt.load())
     rows: list[tuple[int, int]] = []
     if prefix_screen:
+        # Screening itself runs ON DEVICE: all (segment, start-state)
+        # prefix rows go up in one small batched reach launch (the
+        # prefixes bucket to one tiny kernel shape), replacing
+        # K x S sequential host searches that used to dominate the
+        # segmented check's host time. Rare UNKNOWN prefix rows fall
+        # back to the exact host search.
+        screen_rows: list[tuple[int, int]] = []
+        screen_segs: dict[int, tuple] = {}  # k -> (pre_enc, exact)
         for k in range(K):
             lo, hi = cuts[k], cuts[k + 1]
             j = np.searchsorted(vcuts, lo + prefix_screen)
             pre_end = int(vcuts[j]) if (j < len(vcuts)
                                         and vcuts[j] < hi) else hi
-            if ((pre_end == hi and hi - lo > 2 * prefix_screen)
+            if (pre_end - lo > 2 * prefix_screen
                     or enc.crashed[lo:pre_end].any()):
-                # Big segment with no interior cut, or crashed entries
-                # in the would-be prefix: the exhaustive host search
-                # can branch exponentially there (crashes both forbid
-                # cuts and double the frontier per entry) — leave every
-                # state to the kernel instead of screening (minus
+                # No NEARBY interior cut (the first valid cut sits
+                # deep in the segment — one such "prefix" would pad
+                # the whole screen batch up to its length and cost as
+                # much as the main launch), or crashed entries in the
+                # prefix: screening can't shrink the work cheaply —
+                # leave every state to the main kernel launch (minus
                 # checkpoint-restored entries).
                 rows.extend((k, s) for s in range(S)
                             if resolved.get((k, s)) is None)
                 continue
             exact = pre_end == hi
             pre = segs[k] if exact else enc.segment(lo, pre_end)
-            for s in range(S):
-                if resolved.get((k, s)) is not None:
-                    continue  # restored from the checkpoint
-                mask = search_host_reach(pre.with_init(s))
+            screen_segs[k] = (pre, exact)
+            screen_rows.extend((k, s) for s in range(S)
+                               if resolved.get((k, s)) is None)
+        if screen_rows:
+            ks = sorted(screen_segs)
+            kidx = {k: i for i, k in enumerate(ks)}
+            pre_pb = PackedBatch([screen_segs[k][0] for k in ks])
+            launch_rows = [(kidx[k], s) for k, s in screen_rows]
+            p_out, p_unk = _launch(pre_pb, launch_rows, W, F,
+                                   reach=True)
+            p_out = np.asarray(p_out)[:len(launch_rows)]
+            p_unk = np.asarray(p_unk)[:len(launch_rows)]
+            for i, (k, s) in enumerate(screen_rows):
+                pre, exact = screen_segs[k]
+                mask = (search_host_reach(pre.with_init(s))
+                        if p_unk[i] else int(p_out[i]))
                 if exact:
                     resolved[(k, s)] = mask
                 elif mask == 0:
