@@ -21,6 +21,7 @@ CLIENTS = {
     "set-full": lambda: testing.SetClient(),
     "queue": lambda: testing.QueueClient(),
     "counter": lambda: testing.CounterClient(),
+    "dirty-read": lambda: testing.DirtyReadClient(),
     "unique-ids": lambda: testing.UniqueIdsClient(),
     "long-fork": lambda: testing.TxnClient(),
     "monotonic": lambda: testing.MonotonicClient(),
@@ -46,6 +47,8 @@ def _workload_opts(name: str, opts: dict) -> dict:
                       "ops_per_key": ops // 8 or 1})
     elif name == "causal-reverse":
         wopts.update({"per-key-limit": ops // 4 or 1})
+    elif name == "dirty-read":
+        wopts.update({"concurrency": opts["concurrency"]})
     elif name == "sequential":
         # reserve() would otherwise hand every thread to the writers,
         # leaving zero readers (valid? unknown)

@@ -607,3 +607,64 @@ class SequentialClient(jclient.Client):
                     obs.append(k if k in s.present else None)
             return op.copy(type="ok", value=(op.value, obs))
         raise ValueError(f"unknown f {op.f!r}")
+
+
+class DirtyReadState:
+    """Visible vs committed value sets, for the dirty-read workload.
+    Healthy behavior keeps them identical."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.visible: set = set()
+        self.committed: set = set()
+
+
+class DirtyReadClient(jclient.Client):
+    """In-memory dirty-read client. `dirty_every` makes every Nth
+    write visible-but-never-committed (its ack crashes): readers can
+    observe it, strong reads won't — a dirty read. `lose_every` acks
+    every Nth write but drops it from the committed set — a lost
+    write."""
+
+    def __init__(self, state=None, dirty_every: int = 0,
+                 lose_every: int = 0):
+        self.state = state if state is not None else DirtyReadState()
+        self.dirty_every = dirty_every
+        self.lose_every = lose_every
+        self._writes = 0
+
+    def open(self, test, node):
+        c = DirtyReadClient(self.state, self.dirty_every,
+                            self.lose_every)
+        return c
+
+    def invoke(self, test, op):
+        s = self.state
+        if op.f == "write":
+            self._writes += 1
+            with s.lock:
+                s.visible.add(op.value)
+                if self.dirty_every and \
+                        self._writes % self.dirty_every == 0:
+                    # crashes un-acked; never commits, stays visible
+                    # for a while so a racing read can catch it
+                    return op.copy(type="info", error="conn lost")
+                if self.lose_every and \
+                        self._writes % self.lose_every == 0:
+                    s.visible.discard(op.value)  # acked yet gone
+                    return op.copy(type="ok")
+                s.committed.add(op.value)
+            return op.copy(type="ok")
+        if op.f == "read":
+            with s.lock:
+                found = op.value in s.visible
+            return op.copy(type="ok" if found else "fail")
+        if op.f == "refresh":
+            with s.lock:
+                # convergence: uncommitted in-flight values vanish
+                s.visible = set(s.committed)
+            return op.copy(type="ok")
+        if op.f == "strong-read":
+            with s.lock:
+                return op.copy(type="ok", value=sorted(s.visible))
+        raise ValueError(f"unknown f {op.f!r}")

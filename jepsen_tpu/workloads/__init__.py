@@ -10,6 +10,7 @@ from . import bank  # noqa: F401
 from . import causal  # noqa: F401
 from . import causal_reverse  # noqa: F401
 from . import counter  # noqa: F401
+from . import dirty_read  # noqa: F401
 from . import kafka  # noqa: F401
 from . import long_fork  # noqa: F401
 from . import monotonic  # noqa: F401
@@ -27,6 +28,7 @@ REGISTRY = {
     "causal": causal.workload,
     "causal-reverse": causal_reverse.workload,
     "counter": counter.workload,
+    "dirty-read": dirty_read.workload,
     "kafka": kafka.workload,
     "long-fork": long_fork.workload,
     "monotonic": monotonic.workload,

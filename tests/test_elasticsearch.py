@@ -1,0 +1,238 @@
+"""Elasticsearch suite tests: DB command emission via the dummy
+remote, HTTP driver semantics against an in-memory ES, and
+clusterless end-to-end dirty-read and set runs (mirrors
+elasticsearch/src/jepsen/elasticsearch/{core,dirty_read,sets}.clj)."""
+
+import threading
+
+from jepsen_tpu import control, core, testing
+from jepsen_tpu import generator as gen
+from jepsen_tpu.control.core import Action, Result
+from jepsen_tpu.control.dummy import DummyRemote
+from jepsen_tpu.suites import elasticsearch as es
+
+
+def responder(node, action):
+    if action.cmd.startswith("stat "):
+        return Result(exit=1, out="", err="no such file",
+                      cmd=action.cmd)
+    if action.cmd.startswith("dirname "):
+        return action.cmd.split()[-1].rsplit("/", 1)[0]
+    if action.cmd.startswith("ls -A"):
+        return "elasticsearch-7.17.23"
+    return None
+
+
+def make_test(nodes=("n1", "n2", "n3")):
+    remote = DummyRemote(responder)
+    t = testing.noop_test()
+    t.update(nodes=list(nodes), remote=remote,
+             sessions={n: remote.connect({"host": n}) for n in nodes})
+    return core.prepare_test(t)
+
+
+class TestDB:
+    def test_setup_commands(self):
+        test = make_test()
+        db = es.ElasticsearchDB("7.17.23")
+        with control.with_session(test, "n2"):
+            db.setup(test, "n2")
+        acts = [a for a in test["sessions"]["n2"].log
+                if isinstance(a, Action)]
+        got = " ; ".join(a.cmd for a in acts)
+        assert "elasticsearch-7.17.23-linux-x86_64.tar.gz" in got
+        assert "adduser" in got and "elasticsearch" in got
+        assert "chown -R elasticsearch:elasticsearch" in got
+        # config carries unicast discovery of the whole cluster
+        yml = next(a.stdin for a in acts
+                   if a.stdin and "elasticsearch.yml" in a.cmd)
+        assert 'discovery.seed_hosts: ["n1", "n2", "n3"]' in yml
+        assert "node.name: n2" in yml
+        # the daemon starts as the dedicated user, never root
+        start = next(a for a in acts
+                     if "bin/elasticsearch" in a.cmd
+                     and "start" in a.cmd.lower() or
+                     "daemon" in a.cmd.lower())
+        assert start.sudo == "elasticsearch"
+
+
+class FakeEs:
+    """In-memory ES with per-'node' visibility semantics: indexed docs
+    are immediately visible to get-by-id, but _search only sees docs
+    present at the last _refresh — exactly the near-real-time behavior
+    the dirty-read test exercises."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.docs: set = set()      # committed (acked) ids
+        self.searchable: set = set()
+
+    def request(self, method, path, body=None):
+        with self.lock:
+            if method == "PUT" and path.count("/") == 1:
+                return 200, {"acknowledged": True}
+            if "/_doc/" in path and method == "PUT":
+                doc_id = path.split("/_doc/")[1].split("?")[0]
+                if doc_id in self.docs:
+                    return 409, {"error": "version_conflict"}
+                self.docs.add(doc_id)
+                return 201, {"result": "created"}
+            if "/_doc/" in path and method == "GET":
+                doc_id = path.split("/_doc/")[1]
+                if doc_id in self.docs:
+                    return 200, {"found": True,
+                                 "_source": {"id": doc_id}}
+                return 404, {"found": False}
+            if path.endswith("/_refresh"):
+                self.searchable = set(self.docs)
+                return 200, {"_shards": {"total": 3, "successful": 3,
+                                         "failed": 0}}
+            if path.endswith("/_search"):
+                docs = sorted(self.searchable)
+                after = (body or {}).get("search_after")
+                if after is not None:
+                    docs = [d for d in docs if d > after[0]]
+                size = (body or {}).get("size", 10)
+                page = docs[:size]
+                return 200, {"hits": {"hits": [
+                    {"_id": d, "sort": [d]} for d in page]}}
+            raise AssertionError(f"unexpected {method} {path}")
+
+
+class FakeHttpFactory:
+    def __init__(self, state=None):
+        self.state = state or FakeEs()
+
+    def __call__(self, node, timeout=8.0):
+        http = es.EsHttp(node, timeout=timeout)
+        http.request = self.state.request
+        return http
+
+
+class TestDriver:
+    def test_index_get_refresh_search(self):
+        http = FakeHttpFactory()("n1")
+        assert http.index_doc("dirty_read", "7") is True
+        assert http.get_doc("dirty_read", "7") is True
+        assert http.search_ids("dirty_read") == []  # not refreshed
+        assert http.refresh("dirty_read") is True
+        assert http.search_ids("dirty_read") == ["7"]
+
+    def test_duplicate_create_is_ok(self):
+        http = FakeHttpFactory()("n1")
+        http.index_doc("dirty_read", "3")
+        assert http.index_doc("dirty_read", "3") is True  # 409 -> ok
+
+
+class TestEndToEnd:
+    def _run(self, factory, ops=300, concurrency=6):
+        w = es.dirty_read_workload({"ops": ops,
+                                    "concurrency": concurrency,
+                                    "seed": 11})
+        w["client"].http_factory = factory
+        test = testing.noop_test()
+        test.update(nodes=["n1", "n2", "n3"], concurrency=concurrency,
+                    client=w["client"], checker=w["checker"],
+                    generator=gen.clients(gen.phases(
+                        gen.stagger(0.0004, w["generator"]),
+                        w["final_generator"])))
+        return core.run(test)
+
+    def test_dirty_read_workload_valid(self):
+        test = self._run(FakeHttpFactory())
+        res = test["results"]
+        assert res["valid?"] is True
+        assert res["strong-read-count"] == 6
+        assert res["read-count"] > 0
+
+    def test_lost_write_detected(self):
+        """Acked writes that vanish before the strong read must
+        surface as lost."""
+
+        class Lossy(FakeEs):
+            def __init__(self):
+                super().__init__()
+                self.n = 0
+
+            def request(self, method, path, body=None):
+                if "/_doc/" in path and method == "PUT":
+                    self.n += 1
+                    if self.n % 5 == 0:
+                        return 201, {"result": "created"}  # ack, drop
+                return super().request(method, path, body)
+
+        test = self._run(FakeHttpFactory(Lossy()))
+        res = test["results"]
+        assert res["valid?"] is False
+        assert res["lost-count"] > 0
+
+    def test_dirty_read_detected(self):
+        """Reads observing never-committed docs must surface as
+        dirty."""
+
+        class Dirty(FakeEs):
+            def __init__(self):
+                super().__init__()
+                self.n = 0
+                self.phantom: set = set()
+
+            def request(self, method, path, body=None):
+                if "/_doc/" in path and method == "PUT":
+                    self.n += 1
+                    if self.n % 4 == 0:
+                        doc_id = path.split("/_doc/")[1].split("?")[0]
+                        with self.lock:
+                            self.phantom.add(doc_id)
+                        raise TimeoutError("ack lost")  # info write
+                if "/_doc/" in path and method == "GET":
+                    doc_id = path.split("/_doc/")[1]
+                    if doc_id in self.phantom:
+                        return 200, {"found": True,
+                                     "_source": {"id": doc_id}}
+                return super().request(method, path, body)
+
+        test = self._run(FakeHttpFactory(Dirty()), ops=400)
+        res = test["results"]
+        assert res["valid?"] is False
+        assert res["dirty-count"] > 0
+
+    def test_set_workload(self):
+        w = es.set_workload({"ops": 80})
+        w["client"].http_factory = FakeHttpFactory()
+        test = testing.noop_test()
+        test.update(nodes=["n1"], concurrency=4,
+                    client=w["client"], checker=w["checker"],
+                    generator=gen.clients(gen.phases(
+                        gen.stagger(0.0004, w["generator"]),
+                        w["final_generator"])))
+        test = core.run(test)
+        assert test["results"]["valid?"] is True
+
+
+class TestCli:
+    def test_map_shape(self):
+        opts = {"nodes": ["n1", "n2", "n3"], "concurrency": 6,
+                "ssh": {"dummy": True}, "time_limit": 5}
+        test = es.elasticsearch_test(opts)
+        assert test["name"] == "elasticsearch-dirty-read"
+        assert isinstance(test["db"], es.ElasticsearchDB)
+
+
+class TestPaging:
+    def test_search_pages_past_10000(self):
+        """search_ids must not truncate at one page (review r3)."""
+        state = FakeEs()
+        state.docs = {f"{i:06d}" for i in range(25)}
+        state.searchable = set(state.docs)
+        http = FakeHttpFactory(state)("n1")
+        # tiny pages to force multiple rounds through search_after
+        real = http.request
+
+        def small_pages(method, path, body=None):
+            if path.endswith("/_search") and body:
+                body = dict(body, size=7)
+            return real(method, path, body)
+
+        http.request = small_pages
+        ids = http.search_ids("sets")
+        assert len(ids) == 25 and ids == sorted(ids)

@@ -162,7 +162,7 @@ class TestWorkloadsEndToEnd:
 
     def test_registry_complete(self):
         assert set(workloads.REGISTRY) == {
-            "adya-g2", "bank", "causal", "causal-reverse", "counter",
+            "adya-g2", "bank", "causal", "causal-reverse", "counter", "dirty-read",
             "kafka", "long-fork", "monotonic", "sequential", "queue", "register", "set",
             "set-full", "append", "wr", "unique-ids"}
 
@@ -509,3 +509,58 @@ class TestSequential:
         assert res["valid?"] is True
         assert res["all-count"] + res["some-count"] + \
             res["none-count"] > 0
+
+
+class TestDirtyRead:
+    """elasticsearch dirty_read.clj equivalents."""
+
+    def _run(self, client, ops=200, concurrency=6):
+        from jepsen_tpu import workloads
+
+        w = workloads.dirty_read.workload(
+            {"ops": ops, "concurrency": concurrency, "seed": 5})
+        test = testing.noop_test()
+        test.update(nodes=["n1", "n2", "n3"], concurrency=concurrency,
+                    client=client, checker=w["checker"],
+                    generator=gen.clients(gen.phases(
+                        gen.stagger(0.0003, w["generator"]),
+                        w["final_generator"])))
+        return core.run(test)
+
+    def test_healthy_run_valid(self):
+        test = self._run(testing.DirtyReadClient())
+        res = test["results"]
+        assert res["valid?"] is True
+        assert res["read-count"] > 0
+        assert res["strong-read-count"] == 6
+        assert res["dirty-count"] == 0 and res["lost-count"] == 0
+
+    def test_dirty_read_detected(self):
+        """Visible-but-never-committed writes observed by readers must
+        surface as dirty."""
+        test = self._run(testing.DirtyReadClient(dirty_every=3),
+                         ops=400)
+        res = test["results"]
+        assert res["valid?"] is False
+        assert res["dirty-count"] > 0
+
+    def test_lost_write_detected(self):
+        test = self._run(testing.DirtyReadClient(lose_every=4),
+                         ops=300)
+        res = test["results"]
+        assert res["valid?"] is False
+        assert res["lost-count"] > 0
+
+    def test_no_strong_reads_is_unknown(self):
+        from jepsen_tpu import workloads
+
+        w = workloads.dirty_read.workload({"ops": 30,
+                                           "concurrency": 3})
+        test = testing.noop_test()
+        test.update(nodes=["n1"], concurrency=3,
+                    client=testing.DirtyReadClient(),
+                    checker=w["checker"],
+                    generator=gen.clients(
+                        gen.stagger(0.0003, w["generator"])))
+        test = core.run(test)
+        assert test["results"]["valid?"] == "unknown"
