@@ -1,0 +1,206 @@
+"""Raftis suite tests: DB command emission via the dummy remote, a
+scripted redis-cli, and clusterless end-to-end register/counter runs
+(mirrors raftis/src/jepsen/raftis.clj)."""
+
+import threading
+
+from jepsen_tpu import control, core, testing
+from jepsen_tpu import generator as gen
+from jepsen_tpu.control.core import Action, Result
+from jepsen_tpu.control.dummy import DummyRemote
+from jepsen_tpu.history import Op
+from jepsen_tpu.suites import raftis as rf
+
+
+def responder(node, action):
+    if action.cmd.startswith("stat "):
+        return Result(exit=1, out="", err="no such file",
+                      cmd=action.cmd)
+    if action.cmd.startswith("dirname "):
+        return action.cmd.split()[-1].rsplit("/", 1)[0]
+    if action.cmd.startswith("ls -A"):
+        return "raftis-v1.0"
+    return None
+
+
+class TestDB:
+    def test_setup_commands(self):
+        remote = DummyRemote(responder)
+        test = testing.noop_test()
+        test.update(nodes=["n1", "n2", "n3"], remote=remote,
+                    sessions={n: remote.connect({"host": n})
+                              for n in ["n1", "n2", "n3"]})
+        db = rf.RaftisDB("v1.0")
+        with control.with_session(test, "n2"):
+            db.setup(test, "n2")
+        got = " ; ".join(a.cmd for a in test["sessions"]["n2"].log
+                         if isinstance(a, Action))
+        assert "raftis-v1.0.tar.gz" in got
+        assert "--cluster n1:8901,n2:8901,n3:8901" in got
+        assert "--local_ip n2" in got
+
+
+class FakeRedis:
+    """Single-register + counter store speaking redis-cli reply
+    strings, atomically under a lock."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.kv: dict = {}
+
+    def run(self, *args):
+        with self.lock:
+            cmd = args[0]
+            if cmd == "GET":
+                v = self.kv.get(args[1])
+                return "" if v is None else str(v)
+            if cmd == "SET":
+                self.kv[args[1]] = int(args[2])
+                return "OK"
+            if cmd == "INCRBY":
+                v = self.kv.get(args[1], 0) + int(args[2])
+                self.kv[args[1]] = v
+                return str(v)
+            if cmd == "DECRBY":
+                v = self.kv.get(args[1], 0) - int(args[2])
+                self.kv[args[1]] = v
+                return str(v)
+            raise AssertionError(f"unexpected {args}")
+
+
+class FakeCliFactory:
+    def __init__(self, state=None):
+        self.state = state or FakeRedis()
+
+    def __call__(self, test, node, timeout=5.0):
+        factory = self
+
+        class _C:
+            def run(self, *args):
+                return factory.state.run(*args)
+
+            def close(self):
+                pass
+
+        return _C()
+
+
+def run_workload(workload_fn, opts, factory):
+    w = workload_fn(opts)
+    w["client"].cli_factory = factory
+    test = testing.noop_test()
+    test.update(nodes=["n1", "n2"],
+                concurrency=opts.get("concurrency", 4),
+                client=w["client"], checker=w["checker"],
+                generator=gen.clients(
+                    gen.stagger(0.0004, w["generator"])))
+    return core.run(test)
+
+
+class TestEndToEnd:
+    def test_register_valid(self):
+        test = run_workload(rf.register_workload,
+                            {"ops": 150, "seed": 3},
+                            FakeCliFactory())
+        assert test["results"]["valid?"] is True
+
+    def test_register_detects_stale_read(self):
+        class Stale(FakeRedis):
+            def __init__(self):
+                super().__init__()
+                self.reads = 0
+
+            def run(self, *args):
+                if args[0] == "GET":
+                    self.reads += 1
+                    if self.reads >= 20:
+                        return "99"  # never written
+                return super().run(*args)
+
+        test = run_workload(rf.register_workload,
+                            {"ops": 200, "seed": 5},
+                            FakeCliFactory(Stale()))
+        assert test["results"]["valid?"] is False
+
+    def test_counter_valid(self):
+        test = run_workload(rf.counter_workload,
+                            {"ops": 200, "seed": 7},
+                            FakeCliFactory())
+        assert test["results"]["valid?"] is True
+
+    def test_counter_detects_dropped_increment(self):
+        class Dropping(FakeRedis):
+            def __init__(self):
+                super().__init__()
+                self.n = 0
+
+            def run(self, *args):
+                if args[0] == "INCRBY":
+                    self.n += 1
+                    if self.n % 3 == 0:
+                        # ack with a plausible value, apply nothing
+                        with self.lock:
+                            return str(self.kv.get(args[1], 0))
+                return super().run(*args)
+
+        test = run_workload(rf.counter_workload,
+                            {"ops": 300, "seed": 9},
+                            FakeCliFactory(Dropping()))
+        assert test["results"]["valid?"] is False
+
+
+class TestClientErrors:
+    def test_no_leader_is_definite_fail(self):
+        class NoLeader:
+            def __call__(self, test, node, timeout=5.0):
+                class _C:
+                    def run(self, *args):
+                        from jepsen_tpu.control.core import RemoteError
+
+                        raise RemoteError(
+                            "redis failed", exit=1, out="",
+                            err="ERR write InComplete: no leader "
+                                "node!", cmd="SET", node=node)
+
+                    def close(self):
+                        pass
+
+                return _C()
+
+        c = rf.RaftisRegisterClient(cli_factory=NoLeader()).open(
+            {"nodes": ["n1"]}, "n1")
+        op = Op(type="invoke", process=0, f="write", value=3)
+        assert c.invoke({}, op).type == "fail"
+
+    def test_inline_error_reply_classified(self):
+        """An error reply means the server REJECTED the command — a
+        definite fail, in both tty '(error) ...' and raw exec
+        formatting."""
+        for reply in ("(error) ERR not ready", "ERR not ready"):
+            class ErrReply:
+                def __call__(self, test, node, timeout=5.0,
+                             _reply=reply):
+                    class _C:
+                        def run(self, *args):
+                            return _reply
+
+                        def close(self):
+                            pass
+
+                    return _C()
+
+            c = rf.RaftisRegisterClient(cli_factory=ErrReply()).open(
+                {"nodes": ["n1"]}, "n1")
+            w = c.invoke({}, Op(type="invoke", process=0, f="write",
+                                value=1))
+            r = c.invoke({}, Op(type="invoke", process=0, f="read",
+                                value=None))
+            assert w.type == "fail", reply  # server rejected it
+            assert r.type == "fail", reply
+
+    def test_cli_map(self):
+        opts = {"nodes": ["n1", "n2", "n3"], "concurrency": 3,
+                "ssh": {"dummy": True}, "time_limit": 5}
+        test = rf.raftis_test(opts)
+        assert test["name"] == "raftis-register"
+        assert isinstance(test["db"], rf.RaftisDB)
