@@ -27,6 +27,7 @@ from .. import cli, client as jclient, control, core, db as jdb
 from .. import generator as gen
 from .. import nemesis as jnemesis
 from .. import testing
+from . import common
 from ..control import util as cu
 from ..control.core import RemoteError
 from ..core import primary
@@ -134,39 +135,22 @@ class GaleraDB(jdb.DB):
 # mysql CLI transport
 # ---------------------------------------------------------------------------
 
-class Mysql:
-    """Runs one SQL batch through the node-local mysql CLI (multi-
-    master: each client writes to its own node, galera.clj
-    conn-spec). Split out so tests can stub `run`."""
+class Mysql(common.SqlCli):
+    """Node-local mysql CLI batches (multi-master: each client writes
+    to its own node, galera.clj conn-spec)."""
 
     def __init__(self, test, node, timeout: float = 10.0):
-        self.test = test
-        self.node = node
-        self.timeout = timeout
-        self.sess = control.session(test, node)
-
-    def run(self, sql: str) -> str:
-        with control.with_session(self.test, self.node, self.sess):
-            return control.exec_(
-                "mysql", "-u", USER, f"--password={PASSWORD}",
-                "-D", DB_NAME, "-N", "-B", "-e", sql,
-                timeout=self.timeout)
-
-    def close(self):
-        control.disconnect(self.sess)
+        super().__init__(
+            test, node,
+            ["mysql", "-u", USER, f"--password={PASSWORD}",
+             "-D", DB_NAME, "-N", "-B", "-e"],
+            timeout=timeout)
 
 
-_DEFINITE_RE = re.compile(
-    "|".join([r"deadlock", r"lock wait timeout",
-              r"wsrep has not yet prepared", r"connection refused",
-              r"can't connect", r"unknown mysql server"]), re.I)
-
-
-def _classify(op, e: Exception):
-    msg = f"{getattr(e, 'err', '')} {getattr(e, 'out', '')} {e}"
-    if op.f == "read" or _DEFINITE_RE.search(msg):
-        return op.copy(type="fail", error=msg.strip()[:200])
-    return op.copy(type="info", error=msg.strip()[:200])
+_classify = common.make_classifier([
+    r"deadlock", r"lock wait timeout",
+    r"wsrep has not yet prepared", r"connection refused",
+    r"can't connect", r"unknown mysql server"])
 
 
 class GaleraBankClient(jclient.Client):

@@ -1,0 +1,239 @@
+"""TiDB suite tests: pd/tikv/tidb bootstrap command emission via the
+dummy remote, an in-memory tidb speaking the suite's SQL batches, and
+clusterless end-to-end append/bank/long-fork runs (mirrors
+tidb/src/tidb/*.clj)."""
+
+import re
+import threading
+
+from jepsen_tpu import control, core, testing
+from jepsen_tpu import generator as gen
+from jepsen_tpu.control.core import Action, Result
+from jepsen_tpu.control.dummy import DummyRemote
+from jepsen_tpu.suites import tidb as td
+
+
+def responder(node, action):
+    if action.cmd.startswith("stat "):
+        return Result(exit=1, out="", err="no such file",
+                      cmd=action.cmd)
+    if action.cmd.startswith("dirname "):
+        return action.cmd.split()[-1].rsplit("/", 1)[0]
+    if action.cmd.startswith("ls -A"):
+        return "tidb-v7.5.1-linux-amd64"
+    return None
+
+
+def make_test(nodes=("n1", "n2", "n3")):
+    remote = DummyRemote(responder)
+    t = testing.noop_test()
+    t.update(nodes=list(nodes), remote=remote,
+             sessions={n: remote.connect({"host": n}) for n in nodes})
+    return core.prepare_test(t)
+
+
+class TestDB:
+    def test_daemon_stack_and_schema(self):
+        test = make_test()
+        db = td.TidbDB()
+        control.on_nodes(test, lambda t, n: db.setup(t, n))
+        got1 = " ; ".join(a.cmd for a in test["sessions"]["n1"].log
+                          if isinstance(a, Action))
+        got2 = " ; ".join(a.cmd for a in test["sessions"]["n2"].log
+                          if isinstance(a, Action))
+        for got in (got1, got2):
+            assert "pd-server" in got and "tikv-server" in got \
+                and "tidb-server" in got
+            assert ("--initial-cluster pd-n1=http://n1:2380,"
+                    "pd-n2=http://n2:2380") in got
+            assert "--pd n1:2379,n2:2379,n3:2379" in got
+            assert "--store tikv" in got
+            assert "mariadb-client" in got
+        # pd starts before tikv, tikv before tidb
+        assert got1.index("pd-server") < got1.index("tikv-server") \
+            < got1.index("tidb-server")
+        # schema once, on the primary
+        assert "CREATE DATABASE IF NOT EXISTS jepsen" in got1
+        assert "CREATE DATABASE" not in got2
+
+
+class FakeTidb:
+    """In-memory store executing the suite's SQL batches atomically —
+    a perfectly serializable 'tidb'."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.tables = {f"txn{i}": {} for i in range(td.TABLE_COUNT)}
+        self.lf: dict = {}
+        self.accounts = {i: 10 for i in range(8)}
+
+    def run(self, sql: str) -> str:
+        with self.lock:
+            out = []
+            for stmt in filter(None,
+                               (s.strip() for s in sql.split(";"))):
+                line = self._stmt(stmt)
+                if line is not None:
+                    out.append(line)
+            return "\n".join(out)
+
+    def _stmt(self, s):
+        if s in ("BEGIN", "COMMIT"):
+            return None
+        m = re.match(r"SELECT CONCAT\('m(\d+)=', COALESCE\("
+                     r"\(SELECT val FROM (txn\d+|lf) WHERE "
+                     r"(?:id|k) = (\d+)\), '~'\)\)", s)
+        if m:
+            i, t, k = m.group(1), m.group(2), int(m.group(3))
+            store = self.lf if t == "lf" else self.tables[t]
+            v = store.get(k)
+            return f"m{i}=" + ("~" if v is None else str(v))
+        m = re.match(r"INSERT INTO (txn\d+) \(id, val\) VALUES "
+                     r"\((\d+), '(\d+)'\) ON DUPLICATE KEY", s)
+        if m:
+            t, k, v = m.group(1), int(m.group(2)), m.group(3)
+            cur = self.tables[t].get(k)
+            self.tables[t][k] = v if cur is None else f"{cur},{v}"
+            return None
+        m = re.match(r"INSERT INTO lf \(k, val\) VALUES "
+                     r"\((\d+), (\d+)\)", s)
+        if m:
+            self.lf[int(m.group(1))] = int(m.group(2))
+            return None
+        if "CONCAT('b='" in s:
+            return "b=" + ",".join(f"{i}:{b}" for i, b in
+                                   sorted(self.accounts.items()))
+        raise AssertionError(f"fake tidb can't parse: {s!r}")
+
+
+class FakeSqlFactory:
+    def __init__(self, state=None):
+        self.state = state or FakeTidb()
+
+    def __call__(self, test, node, timeout=10.0):
+        factory = self
+
+        class _S:
+            def run(self, sql):
+                return factory.state.run(sql)
+
+            def close(self):
+                pass
+
+        return _S()
+
+
+def run_workload(workload_fn, opts, factory, extra_test=None):
+    w = workload_fn(opts)
+    w["client"].sql_factory = factory
+    test = testing.noop_test()
+    test.update(nodes=["n1", "n2"],
+                concurrency=opts.get("concurrency", 6),
+                client=w["client"], checker=w["checker"],
+                generator=gen.clients(
+                    gen.stagger(0.0004, gen.limit(
+                        opts.get("gen_ops", 250), w["generator"]))))
+    if w.get("lf-table"):
+        test["lf-table"] = True
+    test.update(extra_test or {})
+    return core.run(test)
+
+
+class TestEndToEnd:
+    def test_append_valid(self):
+        test = run_workload(td.append_workload,
+                            {"ops": 250, "keys": 5, "seed": 3},
+                            FakeSqlFactory())
+        assert test["results"]["valid?"] is True
+
+    def test_append_detects_reversed_read(self):
+        class Corrupt(FakeTidb):
+            def __init__(self):
+                super().__init__()
+                self.n = 0
+
+            def _stmt(self, s):
+                out = super()._stmt(s)
+                if out and out.startswith("m") and "," in out:
+                    self.n += 1
+                    if self.n % 2:
+                        tag, raw = out.split("=", 1)
+                        out = tag + "=" + ",".join(
+                            reversed(raw.split(",")))
+                return out
+
+        test = run_workload(td.append_workload,
+                            {"ops": 300, "keys": 2, "seed": 13},
+                            FakeSqlFactory(Corrupt()))
+        assert test["results"]["valid?"] is False
+
+    def test_long_fork_valid(self):
+        test = run_workload(td.long_fork_workload,
+                            {"ops": 300}, FakeSqlFactory())
+        assert test["results"]["valid?"] is True
+        # reads actually observed written values
+        seen = [m[2] for op in test["history"]
+                if op.type == "ok" and op.f == "txn"
+                for m in op.value if m[0] == "r" and m[2] is not None]
+        assert seen and all(v == 1 for v in seen)
+
+
+class TestBank:
+    def _factory(self):
+        class BankFake(FakeTidb):
+            def __init__(self):
+                super().__init__()
+                self._applied = False
+
+            def _stmt(self, s):
+                if s.startswith("SELECT balance INTO @b1"):
+                    self._b1_from = int(
+                        re.search(r"id = (\d+)", s).group(1))
+                    self._b1 = self.accounts[self._b1_from]
+                    return None
+                m = re.match(r"UPDATE accounts SET balance = balance "
+                             r"([-+]) (\d+) WHERE id = (\d+)", s)
+                if m:
+                    sign, a, acct = (m.group(1), int(m.group(2)),
+                                     int(m.group(3)))
+                    self._applied = self._b1 >= a
+                    if self._applied:
+                        self.accounts[acct] += a if sign == "+" else -a
+                    return None
+                if "applied=" in s:
+                    return ("applied=1" if self._applied
+                            else "applied=0")
+                return super()._stmt(s)
+
+        return FakeSqlFactory(BankFake())
+
+    def test_bank_valid(self):
+        test = run_workload(td.bank_workload,
+                            {"seed": 5, "gen_ops": 200},
+                            self._factory())
+        assert test["results"]["valid?"] is True
+        reads = [op for op in test["history"]
+                 if op.type == "ok" and op.f == "read"]
+        assert reads and all(sum(op.value.values()) == 80
+                             for op in reads)
+
+
+class TestCli:
+    def test_map_and_sweep(self):
+        opts = {"nodes": ["n1", "n2", "n3"], "concurrency": 6,
+                "ssh": {"dummy": True}, "time_limit": 5}
+        test = td.tidb_test(opts)
+        assert test["name"] == "tidb-append"
+        tests = list(td.all_tests(opts))
+        assert len(tests) == 3 * 3  # workloads x fault options
+        lf = td.tidb_test({**opts, "workload": "long-fork"})
+        assert lf["lf-table"] is True
+
+    def test_kill_fault_wires_db_package(self):
+        opts = {"nodes": ["n1"], "concurrency": 2,
+                "ssh": {"dummy": True}, "faults": ["kill"],
+                "time_limit": 5}
+        test = td.tidb_test(opts)
+        # the composed package nemesis, not the bare partitioner
+        bare = td.tidb_test({**opts, "faults": None})
+        assert type(test["nemesis"]) is not type(bare["nemesis"])
