@@ -20,6 +20,10 @@ Baselines: config 2's is the 60 s target scaled to history size; the
 others use the host reference engines (pure-Python elle / per-op fold)
 measured in-process, so vs_baseline = host_time / device_time.
 
+EVERY config reports the median of 3 timed runs and prints the
+individual run times (the box shows up to ~30% run-to-run noise; a
+single-run figure can hide a real regression or fake one).
+
 BENCH_OPS scales config 2 (e.g. BENCH_OPS=100000 for a CPU smoke run);
 BENCH_SKIP_EXTRAS=1 runs the headline config only.
 """
@@ -53,7 +57,9 @@ def _bench_elle(label, metric, hist, check_fn):
         host_times.append(time.time() - t0)
     host_s = statistics.median(host_times)
     assert host["valid?"] is True
-    _log(f"{label}: device {dev:.2f}s host {host_s:.2f}s")
+    _log(f"{label}: device runs {['%.2f' % t for t in times]} "
+         f"median {dev:.2f}s | host runs "
+         f"{['%.2f' % t for t in host_times]} median {host_s:.2f}s")
     return {
         "metric": metric,
         "value": round(len(hist) // 2 / dev, 1),
@@ -118,7 +124,9 @@ def bench_bank(n_txns=500_000):
         host_times.append(time.time() - t0)
     host_s = statistics.median(host_times)
     assert bad == 0 and reads == res["read-count"]
-    _log(f"config4: device {dev:.2f}s host-fold {host_s:.2f}s")
+    _log(f"config4: device runs {['%.2f' % t for t in times]} "
+         f"median {dev:.2f}s | host-fold runs "
+         f"{['%.2f' % t for t in host_times]} median {host_s:.2f}s")
     return {
         "metric": f"bank balance-conservation check ({n_txns // 1000}k txns)",
         "value": round(n_txns / dev, 1),
@@ -159,7 +167,8 @@ def bench_ensemble(n_hists=1024, ops_each=400, crash_p=0.15):
     for h in sample:
         wgl.search_host(encode(model, h))
     host_s = (time.time() - t0) * (n_hists / len(sample))
-    _log(f"config5: {n_hists} histories device {dev:.2f}s "
+    _log(f"config5: {n_hists} histories device runs "
+         f"{['%.2f' % t for t in times]} median {dev:.2f}s "
          f"host-extrapolated {host_s:.1f}s")
     return {
         "metric": f"ensemble linearizability ({n_hists} histories, "

@@ -640,24 +640,28 @@ def set_full(checker_opts: dict | None = None) -> Checker:
     return _Fn(run)
 
 
-def _expand_drains(hist: History) -> list:
-    """Expands ok :drain ops into dequeue invoke/ok pairs
-    (checker.clj:614-646)."""
-    out = []
+def _expand_drains(hist: History) -> tuple:
+    """Expands :drain ops into dequeue invoke/ok pairs
+    (checker.clj:614-646). An :info drain (aborted mid-loop, e.g. the
+    broker went away) still contributes its fetched values — ack'd
+    messages are really gone — but is counted as aborted, so the
+    conservation verdict can degrade to unknown instead of reporting
+    still-enqueued messages as lost. Returns (ops, aborted_drains)."""
+    out, aborted = [], 0
     for op in hist:
         if op.f != "drain":
             out.append(op)
         elif op.type in ("invoke", "fail"):
             continue
-        elif op.type == "ok":
+        else:
+            if op.type == "info":
+                aborted += 1
             for element in op.value or []:
                 out.append(op.copy(index=-1, type="invoke", f="dequeue",
                                    value=None))
                 out.append(op.copy(index=-1, type="ok", f="dequeue",
                                    value=element))
-        else:
-            raise ValueError(f"crashed drain operation: {op!r}")
-    return out
+    return out, aborted
 
 
 def total_queue() -> Checker:
@@ -665,7 +669,7 @@ def total_queue() -> Checker:
     (checker.clj:648-708)."""
 
     def run(test, hist, opts):
-        ops = _expand_drains(hist)
+        ops, aborted_drains = _expand_drains(hist)
         attempts = Counter(o.value for o in ops
                            if o.f == "enqueue" and o.type == "invoke")
         enqueues = Counter(o.value for o in ops
@@ -678,8 +682,17 @@ def total_queue() -> Checker:
         duplicated = dequeues - attempts - unexpected
         lost = enqueues - dequeues
         recovered = ok - enqueues
+        if unexpected:
+            valid = False
+        elif lost:
+            # if a drain aborted, "lost" messages may simply still sit
+            # in the queue nobody finished draining: indeterminate
+            valid = "unknown" if aborted_drains else False
+        else:
+            valid = True
         return {
-            "valid?": not lost and not unexpected,
+            "valid?": valid,
+            "aborted-drain-count": aborted_drains,
             "attempt-count": sum(attempts.values()),
             "acknowledged-count": sum(enqueues.values()),
             "ok-count": sum(ok.values()),

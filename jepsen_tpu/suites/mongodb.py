@@ -209,6 +209,21 @@ def _classify(op, e: Exception):
     return op.copy(type="info", error=msg.strip()[:200])
 
 
+def _update_reply_problem(res: dict):
+    """Mongo can answer ok:1 while the update itself failed: per-document
+    errors ride in writeErrors (definite — the write did not apply, e.g.
+    E11000 from a concurrent upsert race) and unmet durability rides in
+    writeConcernError (indefinite — applied locally, replication unknown).
+    Returns ("fail"|"info", msg) or (None, None)."""
+    we = res.get("writeErrors")
+    if we:
+        return "fail", str(we)[:200]
+    wce = res.get("writeConcernError")
+    if wce:
+        return "info", str(wce)[:200]
+    return None, None
+
+
 class MongoCasClient(jclient.Client):
     """Per-key document register (document_cas.clj Client, 40-83):
     write is an upsert, cas a query-guarded update judged by the
@@ -261,6 +276,11 @@ class MongoCasClient(jclient.Client):
                     "writeConcern": self._wc()})
                 if res.get("ok") != 1:
                     raise RuntimeError(str(res.get("errmsg")))
+                kind, msg = _update_reply_problem(res)
+                if kind is not None:
+                    return op.copy(type=kind, error=msg)
+                if res.get("n", 0) < 1:
+                    return op.copy(type="fail", error="upsert matched 0")
                 return op.copy(type="ok")
             if op.f == "cas":
                 old, new = v
@@ -271,6 +291,9 @@ class MongoCasClient(jclient.Client):
                     "writeConcern": self._wc()})
                 if res.get("ok") != 1:
                     raise RuntimeError(str(res.get("errmsg")))
+                kind, msg = _update_reply_problem(res)
+                if kind is not None:
+                    return op.copy(type=kind, error=msg)
                 n = res.get("nModified", res.get("n", 0))
                 if n == 0:
                     return op.copy(type="fail")

@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import json
 import logging
+import time
 
 from .. import checker as chk
 from .. import cli, client as jclient, control, core, db as jdb
@@ -196,19 +197,40 @@ class RabbitQueueClient(jclient.Client):
             if op.f == "dequeue":
                 return self._dequeue(op)
             if op.f == "drain":
+                # Transient fetch errors must not end the drain as
+                # :ok — messages left in the queue would read as lost.
+                # Retry (up to 5 CONSECUTIVE failures; post-heal
+                # drains make errors rare). But any errored get may
+                # also have consumed a message whose reply was lost
+                # (ack_requeue_false removes server-side), so a drain
+                # that saw ANY error is indeterminate: complete :info
+                # keeping fetched values (acked messages are really
+                # gone) so the conservation checker sees an aborted
+                # drain, never a definite empty-queue claim.
+                consecutive, any_error, last_err = 0, False, ""
                 while True:
-                    r = self._dequeue(op)
+                    try:
+                        r = self._dequeue(op)
+                    except RemoteError as e:
+                        consecutive += 1
+                        any_error = True
+                        last_err = (f"{e.err or ''} "
+                                    f"{e.out or ''}").strip()[:200]
+                        if consecutive >= 5:
+                            return op.copy(type="info", value=values,
+                                           error=last_err)
+                        time.sleep(0.2 * consecutive)
+                        continue
+                    consecutive = 0
                     if r.type != "ok":
+                        if any_error:
+                            return op.copy(type="info", value=values,
+                                           error=last_err)
                         return op.copy(type="ok", value=values)
                     values.append(r.value)
             raise ValueError(f"unknown f {op.f!r}")
         except RemoteError as e:
             err = f"{e.err or ''} {e.out or ''}".strip()[:200]
-            if op.f == "drain":
-                # keep what we already fetched (acked messages never
-                # come back); the drain ends like the reference's
-                # when a dequeue inside it errors
-                return op.copy(type="ok", value=values, error=err)
             if op.f == "dequeue":
                 # get-with-ack REMOVES the message when the server
                 # processes the request, so a lost response may have

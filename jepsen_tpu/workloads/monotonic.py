@@ -1,7 +1,8 @@
 """Monotonic-inserts workload: each add reads the current max value
-and inserts max+1 with a database timestamp; the final read must come
-back in an order where timestamps strictly increase and values never
-go backwards.
+and inserts max+1 with a database timestamp; in the final read,
+sorted by timestamp, timestamps must never run backwards (ties are
+legal — non-strict <=) and values must strictly increase (a duplicate
+value IS a reorder) — monotonic.clj comparator semantics.
 
 Capability reference: cockroachdb/src/jepsen/cockroach/monotonic.clj —
 client (81-140: add = query max, insert max+1 with system timestamp,
@@ -23,10 +24,10 @@ from .. import generator as gen
 
 
 def _non_monotonic(rows, field, strict: bool) -> list:
-    """Adjacent pairs where the field fails to increase. strict=True
-    requires x < x' (timestamps: duplicates are violations);
-    strict=False requires x <= x' (values: duplicates are flagged by
-    the separate dup check) — monotonic.clj non-monotonic."""
+    """Adjacent pairs where the field fails to increase. Per
+    monotonic.clj check-monotonic: timestamps use non-strict <=
+    (ties are legal — two txns may share a commit timestamp), while
+    values use strict < (a duplicate value IS a reorder)."""
     vals = np.asarray([r[field] for r in rows])
     if len(vals) < 2:
         return []
@@ -38,7 +39,7 @@ def _non_monotonic_by(rows, group_field, field) -> dict:
     groups: dict = {}
     for r in rows:
         groups.setdefault(r[group_field], []).append(r)
-    return {g: _non_monotonic(rs, field, strict=False)
+    return {g: _non_monotonic(rs, field, strict=True)
             for g, rs in sorted(groups.items())}
 
 
@@ -69,8 +70,8 @@ def check_monotonic(hist, global_: bool = True) -> dict:
     lost = adds_set - read_set
     revived = read_set & fails
     recovered = read_set & infos
-    off_sts = _non_monotonic(rows, "sts", strict=True)
-    off_vals = _non_monotonic(rows, "val", strict=False)
+    off_sts = _non_monotonic(rows, "sts", strict=False)
+    off_vals = _non_monotonic(rows, "val", strict=True)
     by_process = _non_monotonic_by(rows, "process", "val")
     by_node = _non_monotonic_by(rows, "node", "val")
     by_table = _non_monotonic_by(rows, "tb", "val")

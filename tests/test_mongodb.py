@@ -180,6 +180,51 @@ class TestClient:
         assert state.commands[-1]["readConcern"] == {
             "level": "linearizable"}
 
+    def test_write_errors_in_ok_reply_are_fail(self):
+        """Mongo answers ok:1 with per-document writeErrors (e.g.
+        E11000 upsert race): the write did NOT apply — definite fail,
+        never :ok."""
+
+        class Racy(FakeMongo):
+            def run_command(self, command, admin=False):
+                if "update" in command:
+                    return {"ok": 1, "n": 0, "writeErrors": [
+                        {"index": 0, "code": 11000,
+                         "errmsg": "E11000 duplicate key"}]}
+                return super().run_command(command, admin)
+
+        c, _ = self._client(Racy())
+        r = c.invoke({}, kop("write", 0, 3))
+        assert r.type == "fail" and "11000" in r.error
+        r = c.invoke({}, kop("cas", 0, [1, 2]))
+        assert r.type == "fail" and "11000" in r.error
+
+    def test_write_concern_error_is_info(self):
+        """Applied locally but durability unmet: indeterminate."""
+
+        class Undurable(FakeMongo):
+            def run_command(self, command, admin=False):
+                res = super().run_command(command, admin)
+                if "update" in command:
+                    res["writeConcernError"] = {
+                        "code": 64, "errmsg": "waiting for replication"}
+                return res
+
+        c, _ = self._client(Undurable())
+        assert c.invoke({}, kop("write", 0, 3)).type == "info"
+        assert c.invoke({}, kop("cas", 0, [3, 4])).type == "info"
+
+    def test_unapplied_upsert_is_fail(self):
+        class Noop(FakeMongo):
+            def run_command(self, command, admin=False):
+                if "update" in command and \
+                        "value" not in command["updates"][0]["q"]:
+                    return {"ok": 1, "n": 0, "nModified": 0}
+                return super().run_command(command, admin)
+
+        c, _ = self._client(Noop())
+        assert c.invoke({}, kop("write", 0, 3)).type == "fail"
+
     def test_not_primary_is_definite_fail(self):
         class Down:
             def __call__(self, test, node, direct=False, timeout=10.0):

@@ -139,6 +139,30 @@ class TestEndToEnd:
         assert not res["lost"] and not res["unexpected"]
         assert res["ok-count"] > 0
 
+    def test_aborted_drain_degrades_loss_to_unknown(self):
+        """Undrained messages behind an :info drain are indeterminate,
+        not lost: the queue may still hold them."""
+        from jepsen_tpu import checker as chk
+        from jepsen_tpu.history import History, Op
+
+        hist = History([
+            Op(index=0, type="invoke", process=0, f="enqueue", value=1),
+            Op(index=1, type="ok", process=0, f="enqueue", value=1),
+            Op(index=2, type="invoke", process=0, f="enqueue", value=2),
+            Op(index=3, type="ok", process=0, f="enqueue", value=2),
+            Op(index=4, type="invoke", process=1, f="drain", value=None),
+            Op(index=5, type="info", process=1, f="drain", value=[1]),
+        ])
+        res = chk.total_queue().check({}, hist, {})
+        assert res["valid?"] == "unknown"
+        assert res["lost"] == {2: 1}
+        assert res["aborted-drain-count"] == 1
+        # same history with a completed drain: definitely lost
+        done = History(list(hist[:5]) + [
+            Op(index=5, type="ok", process=1, f="drain", value=[1])])
+        res2 = chk.total_queue().check({}, done, {})
+        assert res2["valid?"] is False
+
     def test_queue_declared_at_setup(self):
         factory = FakeAdminFactory()
         run_queue_test(factory)
@@ -238,8 +262,9 @@ class TestClientErrors:
                                    f="enqueue", value=7))
         assert enq.type == "fail"
 
-    def test_drain_error_keeps_collected_values(self):
-        calls = {"n": 0}
+    def _flaky_admin_factory(self, calls, fail_from, fail_count):
+        """get #1..fail_from-1 return messages, then `fail_count`
+        RemoteErrors, then empty replies."""
 
         class Flaky:
             def __call__(self, test, node, timeout=8.0):
@@ -247,12 +272,13 @@ class TestClientErrors:
                     def run(self, *args):
                         if args[0] == "get":
                             calls["n"] += 1
-                            if calls["n"] <= 2:
+                            if calls["n"] < fail_from:
                                 return ('[{"payload": "%d"}]'
                                         % calls["n"])
-                            raise RemoteError("conn reset", exit=1,
-                                              out="", err="reset",
-                                              cmd="x", node=node)
+                            if calls["n"] < fail_from + fail_count:
+                                raise RemoteError(
+                                    "conn reset", exit=1, out="",
+                                    err="reset", cmd="x", node=node)
                         return ""
 
                     def close(self):
@@ -260,13 +286,89 @@ class TestClientErrors:
 
                 return _Admin()
 
-        client = rmq.RabbitQueueClient(admin_factory=Flaky()).open(
-            {}, "n1")
+        return Flaky()
+
+    def test_drain_retries_transient_error_to_completion(self,
+                                                         monkeypatch):
+        monkeypatch.setattr(rmq.time, "sleep", lambda s: None)
+        calls = {"n": 0}
+        client = rmq.RabbitQueueClient(
+            admin_factory=self._flaky_admin_factory(
+                calls, fail_from=3, fail_count=2)).open({}, "n1")
+        from jepsen_tpu.history import Op
+
+        r = client.invoke({}, Op(type="invoke", process=0, f="drain",
+                                 value=None))
+        # the two transient errors are retried through to the empty
+        # reply, but either errored get may have consumed a message
+        # whose reply was lost: the drain is :info, never an :ok
+        # empty-queue claim
+        assert r.type == "info" and r.value == [1, 2]
+
+    def test_clean_drain_is_ok(self):
+        calls = {"n": 0}
+        client = rmq.RabbitQueueClient(
+            admin_factory=self._flaky_admin_factory(
+                calls, fail_from=3, fail_count=0)).open({}, "n1")
         from jepsen_tpu.history import Op
 
         r = client.invoke({}, Op(type="invoke", process=0, f="drain",
                                  value=None))
         assert r.type == "ok" and r.value == [1, 2]
+
+    def test_drain_error_counter_resets_on_success(self, monkeypatch):
+        """4 errors, a success, 4 more errors: never 5 consecutive, so
+        the drain keeps going to completion (as :info)."""
+        monkeypatch.setattr(rmq.time, "sleep", lambda s: None)
+        calls = {"n": 0}
+        pattern = (["msg"] * 2 + ["err"] * 4 + ["msg"] + ["err"] * 4
+                   + ["msg"] + ["empty"])
+
+        class Scripted:
+            def __call__(self, test, node, timeout=8.0):
+                class _Admin:
+                    def run(self, *args):
+                        if args[0] != "get":
+                            return ""
+                        step = pattern[min(calls["n"],
+                                           len(pattern) - 1)]
+                        calls["n"] += 1
+                        if step == "err":
+                            raise RemoteError(
+                                "conn reset", exit=1, out="",
+                                err="reset", cmd="x", node=node)
+                        if step == "msg":
+                            return ('[{"payload": "%d"}]'
+                                    % calls["n"])
+                        return ""
+
+                    def close(self):
+                        pass
+
+                return _Admin()
+
+        client = rmq.RabbitQueueClient(admin_factory=Scripted()).open(
+            {}, "n1")
+        from jepsen_tpu.history import Op
+
+        r = client.invoke({}, Op(type="invoke", process=0, f="drain",
+                                 value=None))
+        assert r.type == "info" and len(r.value) == 4
+
+    def test_drain_persistent_error_is_info(self, monkeypatch):
+        monkeypatch.setattr(rmq.time, "sleep", lambda s: None)
+        calls = {"n": 0}
+        client = rmq.RabbitQueueClient(
+            admin_factory=self._flaky_admin_factory(
+                calls, fail_from=3, fail_count=99)).open({}, "n1")
+        from jepsen_tpu.history import Op
+
+        r = client.invoke({}, Op(type="invoke", process=0, f="drain",
+                                 value=None))
+        # broker never came back: the drain is indeterminate, NOT an
+        # :ok empty-queue claim (messages left behind are not "lost")
+        assert r.type == "info" and r.value == [1, 2]
+        assert "reset" in r.error
 
     def test_cli_map(self):
         opts = {"nodes": ["n1", "n2", "n3"], "concurrency": 3,
