@@ -32,6 +32,18 @@ CLIENTS = {
     "causal": lambda: testing.CausalClient(),
     "causal-reverse": lambda: testing.PerKeySetClient(),
     "adya-g2": lambda: testing.G2Client(),
+    "lock": lambda: testing.LockClient(fences=False),
+    "owner-lock": lambda: testing.LockClient(fences=False),
+    "fenced-lock": lambda: testing.LockClient(),
+    "reentrant-lock": lambda: testing.LockClient(reentrant_limit=2),
+    "semaphore": lambda: testing.LockClient(
+        testing.LockState(permits=2), semaphore=True),
+    "upsert": lambda: testing.UpsertClient(),
+    "run-coverage": lambda: testing.SchedulerClient(),
+    "pages": lambda: testing.PagesClient(),
+    "multimonotonic": lambda: testing.MultiRegClient(),
+    "lost-updates": lambda: testing.VersionedSetClient(),
+    "version-divergence": lambda: testing.VersionRegClient(),
 }
 
 
@@ -54,6 +66,20 @@ def _workload_opts(name: str, opts: dict) -> dict:
         # leaving zero readers (valid? unknown)
         wopts.update({"writers": workloads.sequential.default_writers(
             opts["concurrency"])})
+    elif name == "multimonotonic":
+        # half the threads write (one key each), half read
+        wopts.update({"writers": max(1, opts["concurrency"] // 2)})
+    elif name == "run-coverage":
+        wopts.update({"jobs": min(ops, 50)})
+    elif name in ("upsert", "pages", "lost-updates",
+                  "version-divergence"):
+        # independent-key groups must divide the thread count; budget
+        # ops per key so every key reaches its final-read phase inside
+        # the time limit (an unread key is an honest 'unknown').
+        # pages' atomic insert size is its own knob (elements_per_add),
+        # NOT group_size — thread count must never resize the groups.
+        wopts.update({"group_size": opts["concurrency"],
+                      "ops_per_key": max(ops // 8, 1)})
     return wopts
 
 

@@ -668,3 +668,338 @@ class DirtyReadClient(jclient.Client):
             with s.lock:
                 return op.copy(type="ok", value=sorted(s.visible))
         raise ValueError(f"unknown f {op.f!r}")
+
+
+class LockState:
+    """Shared in-memory lock service: owner, reentrancy count, and a
+    monotonic fencing-token counter."""
+
+    def __init__(self, permits: int = 1):
+        self.lock = threading.Lock()
+        self.owner = None
+        self.count = 0
+        self.fence = 0
+        self.permits = permits
+        self.held: dict = {}  # process -> permits held (semaphore mode)
+
+
+class LockClient(jclient.Client):
+    """In-memory fenced lock / semaphore client (the hazelcast.clj
+    client families). `reentrant_limit` > 1 allows nested acquires;
+    `semaphore=True` switches to permit semantics; `steal_every`
+    grants every Nth busy acquire anyway WITHOUT a fresh fence — a
+    mutual-exclusion violation with a stale token, the classic
+    fencing failure."""
+
+    def __init__(self, state=None, reentrant_limit: int = 1,
+                 semaphore: bool = False, steal_every: int = 0,
+                 fences: bool = True):
+        self.state = state if state is not None else LockState()
+        self.reentrant_limit = reentrant_limit
+        self.semaphore = semaphore
+        self.steal_every = steal_every
+        self.fences = fences
+        self._attempts = 0
+
+    def open(self, test, node):
+        c = LockClient(self.state, self.reentrant_limit,
+                       self.semaphore, self.steal_every, self.fences)
+        return c
+
+    def _sem_invoke(self, s: LockState, op):
+        total = sum(s.held.values())
+        mine = s.held.get(op.process, 0)
+        if op.f == "acquire":
+            if total < s.permits:
+                s.held[op.process] = mine + 1
+                return op.copy(type="ok")
+            return op.copy(type="fail", error="no permits")
+        if mine > 0:
+            s.held[op.process] = mine - 1
+            return op.copy(type="ok")
+        return op.copy(type="fail", error="not-permit-owner")
+
+    def invoke(self, test, op):
+        s = self.state
+        with s.lock:
+            if self.semaphore:
+                return self._sem_invoke(s, op)
+            if op.f == "acquire":
+                self._attempts += 1
+                if s.owner is None or (s.owner == op.process
+                                       and s.count
+                                       < self.reentrant_limit):
+                    first = s.owner is None
+                    s.owner = op.process
+                    s.count += 1
+                    if first and self.fences:
+                        s.fence += 1
+                    val = {"fence": s.fence} if self.fences else None
+                    return op.copy(type="ok", value=val)
+                if self.steal_every and \
+                        self._attempts % self.steal_every == 0:
+                    # grants despite a holder, reusing a stale fence
+                    s.owner = op.process
+                    s.count = 1
+                    val = {"fence": s.fence} if self.fences else None
+                    return op.copy(type="ok", value=val)
+                return op.copy(type="fail", error="busy")
+            if op.f == "release":
+                if s.owner != op.process:
+                    return op.copy(type="fail",
+                                   error="not-lock-owner")
+                s.count -= 1
+                if s.count == 0:
+                    s.owner = None
+                return op.copy(type="ok")
+        raise ValueError(f"unknown f {op.f!r}")
+
+
+class UpsertClient(jclient.Client):
+    """Per-key insert-unless-exists returning a fresh uid on creation
+    (dgraph upsert.clj client). race_every lets every Nth contended
+    upsert create a SECOND record for the key — the uniqueness
+    violation the checker must catch."""
+
+    def __init__(self, state=None, race_every: int = 0):
+        self.state = state if state is not None else {
+            "lock": threading.Lock(), "rows": {}, "next_uid": 1,
+            "attempts": 0}
+        self.race_every = race_every
+
+    def open(self, test, node):
+        return UpsertClient(self.state, self.race_every)
+
+    def invoke(self, test, op):
+        from . import independent
+
+        s = self.state
+        k = independent.key_(op.value)
+        with s["lock"]:
+            rows = s["rows"].setdefault(k, [])
+            if op.f == "upsert":
+                s["attempts"] += 1
+                racing = self.race_every and \
+                    s["attempts"] % self.race_every == 0
+                if rows and not racing:
+                    return op.copy(type="fail", error="exists")
+                uid = s["next_uid"]
+                s["next_uid"] += 1
+                rows.append(uid)
+                return op.copy(
+                    type="ok", value=independent.ktuple(k, uid))
+            if op.f == "read":
+                return op.copy(
+                    type="ok",
+                    value=independent.ktuple(k, sorted(rows)))
+        raise ValueError(f"unknown f {op.f!r}")
+
+
+class SchedulerClient(jclient.Client):
+    """In-memory job scheduler (chronos.clj shape): add-job records
+    the spec; the final read synthesizes the runs a faithful scheduler
+    would have produced for every due target (start jittered within
+    epsilon, completed after `duration`). miss_every drops every Nth
+    target's run — the lost-invocation bug run-coverage must flag."""
+
+    def __init__(self, state=None, miss_every: int = 0,
+                 late_every: int = 0):
+        self.state = state if state is not None else {
+            "lock": threading.Lock(), "jobs": []}
+        self.miss_every = miss_every
+        self.late_every = late_every
+
+    def open(self, test, node):
+        return SchedulerClient(self.state, self.miss_every,
+                               self.late_every)
+
+    def invoke(self, test, op):
+        from .workloads import scheduler as sched
+
+        s = self.state
+        with s["lock"]:
+            if op.f == "add-job":
+                s["jobs"].append(dict(op.value))
+                return op.copy(type="ok")
+            if op.f == "read":
+                read_time = max(
+                    [j["start"] + j["interval"] * j["count"]
+                     for j in s["jobs"]] + [0.0]) + 60.0
+                runs, n = [], 0
+                for job in s["jobs"]:
+                    for (t0, _dl) in sched.job_targets(
+                            read_time, job):
+                        n += 1
+                        if self.miss_every and \
+                                n % self.miss_every == 0:
+                            continue
+                        start = t0 + (job["epsilon"] + 30.0
+                                      if self.late_every
+                                      and n % self.late_every == 0
+                                      else 0.5)
+                        runs.append({"name": job["name"],
+                                     "start": start,
+                                     "end": start + job["duration"]})
+                return op.copy(type="ok", value={"time": read_time,
+                                                 "runs": runs})
+        raise ValueError(f"unknown f {op.f!r}")
+
+
+class PagesClient(jclient.Client):
+    """Per-key element store with atomic group inserts (faunadb
+    pages.clj client). tear_every serves every Nth read while a group
+    is half-applied — the pagination-isolation anomaly."""
+
+    def __init__(self, state=None, tear_every: int = 0):
+        self.state = state if state is not None else {
+            "lock": threading.Lock(), "rows": {}, "reads": 0}
+        self.tear_every = tear_every
+
+    def open(self, test, node):
+        return PagesClient(self.state, self.tear_every)
+
+    def invoke(self, test, op):
+        from . import independent
+
+        s = self.state
+        k = independent.key_(op.value)
+        v = independent.value_(op.value)
+        with s["lock"]:
+            rows = s["rows"].setdefault(k, [])
+            if op.f == "add":
+                rows.extend(v)
+                return op.copy(type="ok")
+            if op.f == "read":
+                s["reads"] += 1
+                vals = list(rows)
+                if self.tear_every and \
+                        s["reads"] % self.tear_every == 0 and \
+                        len(vals) > 2:
+                    vals = vals[:-2]  # half of the last group missing
+                return op.copy(
+                    type="ok", value=independent.ktuple(k, vals))
+        raise ValueError(f"unknown f {op.f!r}")
+
+
+class MultiRegClient(jclient.Client):
+    """Increment-only multi-register store with a logical read
+    timestamp (faunadb multimonotonic.clj client). stale_every serves
+    every Nth read from an old snapshot with a CURRENT timestamp —
+    the ts-order violation."""
+
+    def __init__(self, state=None, stale_every: int = 0):
+        self.state = state if state is not None else {
+            "lock": threading.Lock(), "regs": {}, "ts": 0,
+            "reads": 0, "snapshots": []}
+        self.stale_every = stale_every
+
+    def open(self, test, node):
+        return MultiRegClient(self.state, self.stale_every)
+
+    def invoke(self, test, op):
+        s = self.state
+        with s["lock"]:
+            if op.f == "write":
+                for k, v in (op.value or {}).items():
+                    s["regs"][k] = v
+                s["ts"] += 1
+                return op.copy(type="ok")
+            if op.f == "read":
+                s["reads"] += 1
+                s["ts"] += 1
+                regs = dict(s["regs"])
+                stale = (self.stale_every
+                         and s["reads"] % self.stale_every == 0
+                         and s["snapshots"])
+                if stale:
+                    # a lagging replica: values from several reads
+                    # ago served under a CURRENT timestamp
+                    regs = dict(s["snapshots"][0])
+                else:
+                    s["snapshots"].append(dict(regs))
+                    if len(s["snapshots"]) > 8:
+                        s["snapshots"].pop(0)
+                return op.copy(type="ok", value={"ts": s["ts"],
+                                                 "registers": regs})
+        raise ValueError(f"unknown f {op.f!r}")
+
+
+class VersionedSetClient(jclient.Client):
+    """Per-key element list guarded by a row version (crate
+    lost_updates.clj client shape): add re-reads and writes back iff
+    the version is unchanged. lose_every makes every Nth guarded
+    update ack WITHOUT applying — a lost update."""
+
+    def __init__(self, state=None, lose_every: int = 0):
+        self.state = state if state is not None else {
+            "lock": threading.Lock(), "rows": {}, "adds": 0}
+        self.lose_every = lose_every
+
+    def open(self, test, node):
+        return VersionedSetClient(self.state, self.lose_every)
+
+    def invoke(self, test, op):
+        from . import independent
+
+        s = self.state
+        k = independent.key_(op.value)
+        v = independent.value_(op.value)
+        with s["lock"]:
+            row = s["rows"].setdefault(k, {"els": [], "version": 0})
+            if op.f == "add":
+                s["adds"] += 1
+                if self.lose_every and \
+                        s["adds"] % self.lose_every == 0:
+                    return op.copy(type="ok")  # acked, never applied
+                row["els"].append(v)
+                row["version"] += 1
+                return op.copy(type="ok")
+            if op.f == "read":
+                return op.copy(type="ok", value=independent.ktuple(
+                    k, sorted(row["els"])))
+        raise ValueError(f"unknown f {op.f!r}")
+
+
+class VersionRegClient(jclient.Client):
+    """Versioned register (crate version_divergence.clj client):
+    writes bump _version; reads return {value, version}.
+    diverge_every makes every Nth read report a DIFFERENT value under
+    the same version — replica divergence."""
+
+    def __init__(self, state=None, diverge_every: int = 0):
+        self.state = state if state is not None else {
+            "lock": threading.Lock(), "rows": {}, "reads": 0}
+        self.diverge_every = diverge_every
+
+    def open(self, test, node):
+        return VersionRegClient(self.state, self.diverge_every)
+
+    def invoke(self, test, op):
+        from . import independent
+
+        s = self.state
+        k = independent.key_(op.value)
+        v = independent.value_(op.value)
+        with s["lock"]:
+            row = s["rows"].get(k)
+            if op.f == "write":
+                if row is None:
+                    s["rows"][k] = {"value": v, "version": 1}
+                else:
+                    row["value"] = v
+                    row["version"] += 1
+                return op.copy(type="ok")
+            if op.f == "read":
+                s["reads"] += 1
+                if row is None:
+                    return op.copy(
+                        type="ok", value=independent.ktuple(k, None))
+                out = {"value": row["value"],
+                       "version": row["version"]}
+                if self.diverge_every and \
+                        s["reads"] % self.diverge_every == 0:
+                    out = {"value": (row["value"] or 0) + 100000,
+                           "version": row["version"]}
+                return op.copy(
+                    type="ok", value=independent.ktuple(k, out))
+        raise ValueError(f"unknown f {op.f!r}")

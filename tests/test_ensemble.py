@@ -144,6 +144,45 @@ def test_reach_rows_match_host(mesh):
         assert int(out[i]) == host, f"row {(k, s)}"
 
 
+def test_scaling_equivalence_across_mesh_sizes(mesh):
+    """The sharded checker is a pure function of the histories: its
+    answers must be bit-identical whether the mesh has 1, 2, or 8
+    devices (VERDICT r3 #10 — turns 'wired' multi-chip into
+    'verified'). Covers both the data-parallel ensemble path and the
+    segment x start-state reach path."""
+    m = model.cas_register()
+    hists = [synth.register_history(28, n_procs=3, seed=500 + i)
+             for i in range(10)]
+    hists[2] = corrupt(hists[2])
+    hists[7] = corrupt(hists[7])
+    encs = [encode(m, h) for h in hists]
+
+    long_hist = synth.register_history(220, n_procs=4, seed=77)
+    enc = encode(m, long_hist)
+    cuts = wgl.segment_cuts(enc, target_len=32)
+    K = len(cuts) - 1
+    assert K >= 2
+    segs = [enc.segment(cuts[k], cuts[k + 1]) for k in range(K)]
+    S = enc.n_states
+    rows = [(k, s) for k in range(K) for s in range(S)]
+
+    ens_by_n, reach_by_n = {}, {}
+    for n in (1, 2, 8):
+        sub = ensemble.default_mesh(n)
+        assert sub.devices.size == n
+        ens_by_n[n] = list(map(int, ensemble.check_batch_sharded(
+            encs, mesh=sub, W=16, F=16)))
+        out, unk = ensemble.check_batch_sharded(
+            segs, mesh=sub, W=16, F=16, reach=True, rows=rows)
+        reach_by_n[n] = (list(map(int, out)), list(map(bool, unk)))
+
+    assert ens_by_n[1] == ens_by_n[2] == ens_by_n[8]
+    assert reach_by_n[1] == reach_by_n[2] == reach_by_n[8]
+    # and the 1-device answer equals the unsharded kernel's
+    assert ens_by_n[1] == list(map(int, wgl.check_batch(
+        encs, W=16, F=16)))
+
+
 def test_analysis_batch_sharded(mesh):
     m = model.cas_register()
     hists = [synth.register_history(24, n_procs=3, seed=400 + i)
