@@ -138,17 +138,19 @@ class Psql:
     (stolon/client.clj open, minus the JDBC stack). Split out so tests
     can stub `run`."""
 
-    def __init__(self, test, node, host, timeout: float = 10.0):
+    def __init__(self, test, node, host, timeout: float = 10.0,
+                 port: int = PORT):
         self.test = test
         self.node = node
         self.host = host
+        self.port = port
         self.timeout = timeout
         self.sess = control.session(test, node)
 
     def run(self, sql: str) -> str:
         with control.with_session(self.test, self.node, self.sess):
             return control.exec_(
-                "psql", "-h", self.host, "-p", str(PORT),
+                "psql", "-h", self.host, "-p", str(self.port),
                 "-U", USER, "-d", DBNAME,
                 "-X", "-q", "-A", "-t", "-v", "ON_ERROR_STOP=1",
                 "-c", sql, timeout=self.timeout)

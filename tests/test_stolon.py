@@ -1,0 +1,250 @@
+"""Stolon suite tests: DB daemon orchestration via the dummy remote, a
+scripted ledger 'postgres', and clusterless e2e append + ledger runs
+(mirrors stolon/src/jepsen/stolon/{db,ledger}.clj)."""
+
+import re
+import threading
+
+import pytest
+
+from jepsen_tpu import control, core, testing
+from jepsen_tpu import generator as gen
+from jepsen_tpu.control.core import Action, RemoteError
+from jepsen_tpu.control.dummy import DummyRemote
+from jepsen_tpu.history import Op
+from jepsen_tpu.suites import stolon
+
+
+def make_test(responder=None, nodes=("n1", "n2", "n3")):
+    remote = DummyRemote(responder)
+    t = testing.noop_test()
+    t.update(nodes=list(nodes), remote=remote,
+             sessions={n: remote.connect({"host": n}) for n in nodes})
+    return t
+
+
+def cmds(test, node):
+    return [a for a in test["sessions"][node].log
+            if isinstance(a, Action)]
+
+
+class TestDB:
+    def test_cluster_spec(self):
+        t = {"nodes": ["n1", "n2", "n3", "n4", "n5"]}
+        spec = stolon.cluster_spec(t)
+        assert spec["synchronousReplication"] is True
+        assert spec["maxStandbysPerSender"] == 4
+        assert spec["minSynchronousStandbys"] == 1
+
+    def test_pg_ids(self):
+        t = {"nodes": ["n1", "n2", "n3"]}
+        assert stolon.pg_id(t, "n1") == "pg1"
+        assert stolon.pg_id(t, "n3") == "pg3"
+
+    def test_daemons_start_with_store_flags(self):
+        test = make_test()
+        db = stolon.StolonDB()
+        with control.with_session(test, "n2"):
+            db._start_sentinel(test, "n2")
+            db._start_keeper(test, "n2")
+            db._start_proxy(test, "n2")
+        got = " ; ".join(a.cmd for a in cmds(test, "n2"))
+        assert "stolon-sentinel" in got
+        assert "stolon-keeper" in got and "--uid pg2" in got
+        assert "stolon-proxy" in got
+        assert got.count("--store-backend etcdv3") >= 3
+        assert "--initial-cluster-spec" in got
+        assert f"--pg-port {stolon.KEEPER_PG_PORT}" in got
+
+    def test_kill_stops_keeper_only(self):
+        test = make_test()
+        db = stolon.StolonDB()
+        with control.with_session(test, "n1"):
+            db.kill(test, "n1")
+        got = " ; ".join(a.cmd for a in cmds(test, "n1"))
+        assert "keeper" in got
+        assert "proxy" not in got and "sentinel" not in got
+
+
+# ---------------------------------------------------------------------------
+# Scripted ledger postgres
+# ---------------------------------------------------------------------------
+
+class _PgError(Exception):
+    pass
+
+
+class FakeLedgerPg:
+    """Executes the ledger client's SQL shapes; broken=True ignores
+    the non-negativity guard on withdrawals (a double-spend-friendly
+    'postgres', what G2 looks like from the outside)."""
+
+    def __init__(self, broken=False):
+        self.lock = threading.Lock()
+        self.rows = {}  # id -> (account, amount)
+        self.broken = broken
+
+    def _sum(self, account, excl):
+        return sum(a for rid, (acct, a) in self.rows.items()
+                   if acct == account and rid != excl)
+
+    def execute(self, sql: str) -> str:
+        with self.lock:
+            out = []
+            for stmt in filter(None, (s.strip()
+                                      for s in sql.split(";"))):
+                if re.match(r"BEGIN|COMMIT", stmt):
+                    continue
+                m = re.match(r"SELECT 'a=' \|\|", stmt)
+                if m:
+                    totals = {}
+                    for acct, amt in self.rows.values():
+                        totals[acct] = totals.get(acct, 0) + amt
+                    out.append("a=" + ",".join(
+                        f"{a}:{t}" for a, t in sorted(totals.items())))
+                    continue
+                m = re.match(r"INSERT INTO ledger VALUES "
+                             r"\((\d+), (\d+), (-?\d+)\)", stmt)
+                if m:
+                    rid, acct, amt = map(int, m.groups())
+                    self.rows[rid] = (acct, amt)
+                    continue
+                m = re.match(r"SELECT 'bal=' \|\| COALESCE.*"
+                             r"account = (\d+) AND id != (\d+)", stmt)
+                if m:
+                    acct, rid = map(int, m.groups())
+                    out.append(f"bal={self._sum(acct, rid)}")
+                    continue
+                m = re.match(r"INSERT INTO ledger SELECT (\d+), "
+                             r"(\d+), (-?\d+) WHERE", stmt)
+                if m:
+                    rid, acct, amt = map(int, m.groups())
+                    if self.broken or self._sum(acct, rid) + amt >= 0:
+                        self.rows[rid] = (acct, amt)
+                    continue
+                m = re.match(r"SELECT 'n=' \|\| COUNT\(\*\) FROM "
+                             r"ledger WHERE id = (\d+)", stmt)
+                if m:
+                    out.append(
+                        f"n={1 if int(m.group(1)) in self.rows else 0}")
+                    continue
+                raise AssertionError(
+                    f"fake ledger pg can't parse: {stmt!r}")
+            return "\n".join(out) + ("\n" if out else "")
+
+
+class FakeProxyFactory:
+    def __init__(self, state=None):
+        self.state = state or FakeLedgerPg()
+
+    def __call__(self, test, node, host=None, timeout=10.0,
+                 port=stolon.PROXY_PORT):
+        factory = self
+
+        class _Fake:
+            def run(self, sql):
+                try:
+                    return factory.state.execute(sql)
+                except _PgError as e:
+                    raise RemoteError("psql failed", exit=1, out="",
+                                      err=f"ERROR: {e}", cmd="psql",
+                                      node=node)
+
+            def close(self):
+                pass
+
+        return _Fake()
+
+
+class TestLedgerClient:
+    def _client(self, state=None):
+        f = FakeProxyFactory(state)
+        c = stolon.LedgerClient(psql_factory=f).open(
+            {"nodes": ["n1"]}, "n1")
+        return c, f.state
+
+    def _op(self, f, v, process=0):
+        return Op(type="invoke", process=process, f=f, value=v)
+
+    def test_deposit_then_read(self):
+        c, _ = self._client()
+        assert c.invoke({}, self._op("transfer", [0, 10])).type == "ok"
+        r = c.invoke({}, self._op("read", None))
+        assert r.value == {0: 10}
+
+    def test_withdrawal_guard(self):
+        c, _ = self._client()
+        c.invoke({}, self._op("transfer", [0, 10]))
+        assert c.invoke({}, self._op("transfer", [0, -9])).type == "ok"
+        # second -9 would go negative: definite fail
+        r = c.invoke({}, self._op("transfer", [0, -9]))
+        assert r.type == "fail"
+        assert c.invoke({}, self._op("read", None)).value == {0: 1}
+
+    def test_row_ids_disjoint_by_process(self):
+        c, state = self._client()
+        c.invoke({}, self._op("transfer", [0, 5], process=1))
+        c.invoke({}, self._op("transfer", [0, 5], process=2))
+        assert len(state.rows) == 2
+
+
+class TestLedgerChecker:
+    def test_charitable_interpretation(self):
+        hist = [
+            Op(type="ok", process=0, f="transfer", value=[0, 10]),
+            Op(type="info", process=1, f="transfer", value=[0, -9]),
+            Op(type="ok", process=2, f="transfer", value=[0, -9]),
+        ]
+        # info withdrawal doesn't count: 10 - 9 = 1 >= 0
+        assert stolon.check_ledger(hist)["valid?"] is True
+        hist.append(Op(type="ok", process=3, f="transfer",
+                       value=[0, -9]))
+        # two OK withdrawals against one deposit: double-spend
+        res = stolon.check_ledger(hist)
+        assert res["valid?"] is False
+        assert res["errors"][0]["account"] == 0
+
+    def test_info_deposit_counts(self):
+        hist = [
+            Op(type="info", process=0, f="transfer", value=[0, 10]),
+            Op(type="ok", process=1, f="transfer", value=[0, -9]),
+        ]
+        assert stolon.check_ledger(hist)["valid?"] is True
+
+
+class TestEndToEnd:
+    def _run(self, state, ops=200, concurrency=4):
+        w = stolon.ledger_workload({"ops": ops, "seed": 7})
+        w["client"].psql_factory = FakeProxyFactory(state)
+        test = testing.noop_test()
+        test.update(nodes=["n1", "n2"], concurrency=concurrency,
+                    client=w["client"], checker=w["checker"],
+                    generator=gen.clients(
+                        gen.stagger(0.0003, w["generator"])))
+        return core.run(test)
+
+    def test_ledger_valid_on_honest_pg(self):
+        t = self._run(FakeLedgerPg())
+        assert t["results"]["valid?"] is True
+
+    def test_double_spend_detected_on_broken_pg(self):
+        t = self._run(FakeLedgerPg(broken=True), ops=300,
+                      concurrency=6)
+        assert t["results"]["valid?"] is False
+
+
+class TestCli:
+    def test_test_map_shape(self):
+        opts = {"nodes": ["n1", "n2", "n3"], "concurrency": 3,
+                "ssh": {"dummy": True}, "time_limit": 5}
+        test = stolon.stolon_test(opts)
+        assert test["name"] == "stolon-append"
+        assert isinstance(test["db"], stolon.StolonDB)
+        assert test["db"].supports_kill
+
+    def test_ledger_workload_selectable(self):
+        opts = {"nodes": ["n1"], "concurrency": 2,
+                "ssh": {"dummy": True}, "workload": "ledger"}
+        test = stolon.stolon_test(opts)
+        assert test["name"] == "stolon-ledger"
+        assert isinstance(test["client"], stolon.LedgerClient)
