@@ -15,6 +15,8 @@ Also printed (one JSON line each, config 2 last):
   config 3b — elle rw-register cycle check, 100k txns (device SCC)
   config 4 — bank balance-conservation check, 500k txns (array fold)
   config 5 — 1024-history ensemble checked in one batched launch
+  config 6 — time-to-first-anomaly: seeded invalid read at 85% of a
+             1M-event history, localized via segment reach masks
 
 Baselines: config 2's is the 60 s target scaled to history size; the
 others use the host reference engines (pure-Python elle / per-op fold)
@@ -179,6 +181,45 @@ def bench_ensemble(n_hists=1024, ops_each=400, crash_p=0.15):
     }
 
 
+def bench_anomaly(n_events):
+    """Config 6: time-to-first-anomaly. A 1M-event register history
+    with ONE seeded impossible read at ~85% depth; the checker must
+    localize and explain it in bounded time (BASELINE.md names the
+    metric; the reference's knossos pays unbounded search + 'writing
+    these can take hours' on this path, checker.clj:222-233). The
+    timed region is the full user path: encode -> analysis -> witness."""
+    from jepsen_tpu.checker import models
+    from jepsen_tpu.tpu import synth, wgl
+
+    n_invocations = n_events // 2
+    target_s = 60.0 * (n_events / 1_000_000)
+    t0 = time.time()
+    hist = synth.register_history(n_invocations, n_procs=5, seed=42)
+    hist, bad_idx = synth.corrupt_register_history(hist, at_frac=0.85)
+    _log(f"config6: {len(hist)} events, seeded anomaly at event "
+         f"{bad_idx}, generated in {time.time() - t0:.1f}s")
+    model = models.cas_register()
+    wgl.analysis(model, hist)  # warm
+    times = []
+    for _ in range(3):
+        t1 = time.time()
+        res = wgl.analysis(model, hist)
+        times.append(time.time() - t1)
+        assert res["valid?"] is False, res
+    assert "failed-segment" in res, res
+    elapsed = statistics.median(times)
+    _log(f"config6: runs {['%.2f' % t for t in times]} median "
+         f"{elapsed:.2f}s failed-segment={res['failed-segment']} "
+         f"range={res.get('segment-range')}")
+    return {
+        "metric": "time-to-first-anomaly "
+                  f"({len(hist) // 1000}k-event history, seeded invalid read)",
+        "value": round(elapsed, 2),
+        "unit": "s",
+        "vs_baseline": round(target_s / elapsed, 2),
+    }
+
+
 def bench_headline(n_events):
     """Config 2: 1M-event register history, segmented device check."""
     from jepsen_tpu.checker import models
@@ -252,7 +293,8 @@ def main():
                          (bench_rw_register,
                           (10_000 if small else 100_000,)),
                          (bench_bank, (50_000 if small else 500_000,)),
-                         (bench_ensemble, (128 if small else 1024,))):
+                         (bench_ensemble, (128 if small else 1024,)),
+                         (bench_anomaly, (n_events,))):
             try:
                 lines.append(fn(*args))
             except Exception as e:  # extras must never sink the headline

@@ -24,7 +24,6 @@ from __future__ import annotations
 
 import json
 import logging
-import random
 import re
 
 from .. import checker as chk
@@ -179,7 +178,7 @@ class StolonDB(jdb.DB):
             tables.append(f"CREATE TABLE IF NOT EXISTS txn{i} ("
                           "id int NOT NULL PRIMARY KEY, val text)")
         tables.append("CREATE TABLE IF NOT EXISTS ledger ("
-                      "id int PRIMARY KEY, account int NOT NULL, "
+                      "id bigint PRIMARY KEY, account int NOT NULL, "
                       "amount int NOT NULL)")
         tables.append("CREATE INDEX IF NOT EXISTS i_account ON "
                       "ledger (account)")
@@ -240,7 +239,6 @@ class LedgerClient(jclient.Client):
         self.isolation = isolation
         self.psql = None
         self._next_id = 0
-        self._stride = 1
 
     def open(self, test, node):
         c = LedgerClient(self.psql_factory, self.isolation)
@@ -255,10 +253,10 @@ class LedgerClient(jclient.Client):
             self.psql.close()
 
     def _row_id(self, op) -> int:
-        # processes are globally unique; stride by 10k per process
+        # processes are globally unique; stride by 1M per process
         pid = op.process if isinstance(op.process, int) else 0
         self._next_id += 1
-        return pid * 10_000 + self._next_id
+        return pid * 1_000_000 + self._next_id
 
     def invoke(self, test, op):
         try:
@@ -346,7 +344,7 @@ class _LedgerGen(gen.Generator):
 
     def op(self, test, ctx):
         if self.remaining is None:
-            rng = random.Random((self.seed, self.account).__hash__())
+            rng = jutil.seeded_rng(self.seed, self.account)
             burst = 2 ** rng.randrange(5)
             m = gen.fill_in_op(
                 {"f": "transfer", "value": [self.account, 10]}, ctx)

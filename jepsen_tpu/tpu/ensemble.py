@@ -92,8 +92,8 @@ def check_batch_sharded(encs: Sequence[Encoded], mesh=None, W: int = 32,
     return np.asarray(out)[:n_rows]
 
 
-def analysis_batch_sharded(model, hists, mesh=None, W: int = 32,
-                           F: int = 64) -> list[dict]:
+def analysis_batch_sharded(model, hists, mesh=None, W: int | None = None,
+                           F: int | None = None) -> list[dict]:
     """analysis_batch across a mesh: the ensemble benchmark path
     (BASELINE config 5: 1024 generated histories checked concurrently)."""
     from . import wgl as wgl_mod
@@ -114,7 +114,9 @@ def analysis_batch_sharded(model, hists, mesh=None, W: int = 32,
     if encs:
         from .wgl import RangeError
         try:
-            res = check_batch_sharded(encs, mesh=mesh, W=W, F=F)
+            res = check_batch_sharded(encs, mesh=mesh,
+                                      W=W if W is not None else 32,
+                                      F=F if F is not None else 64)
         except RangeError:
             res = [wgl_mod.UNKNOWN] * len(encs)
         for j, i in enumerate(idx_map):
@@ -122,7 +124,10 @@ def analysis_batch_sharded(model, hists, mesh=None, W: int = 32,
             if r == wgl_mod.VALID:
                 results[i] = {"valid?": True, "analyzer": "tpu-sharded"}
             else:
-                out = wgl_mod.search_host(encs[j], witness=True)
+                # Bounded anomaly path: localize the failing segment on
+                # device instead of re-searching the whole history on
+                # host (unbounded at 1M-op scale).
+                out = wgl_mod.extract_witness(encs[j], W=W, F=F)
                 out["analyzer"] = ("tpu-sharded" if r == wgl_mod.INVALID
                                    else "tpu+host-fallback")
                 results[i] = out

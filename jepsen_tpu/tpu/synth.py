@@ -80,6 +80,33 @@ def register_history(n_ops: int, n_procs: int = 5, seed: int = 0,
     return History(ops, assign_indices=False)
 
 
+def corrupt_register_history(hist: History, at_frac: float = 0.85,
+                             bogus: int | None = None) -> tuple[History, int]:
+    """Seeds ONE linearizability anomaly into a valid register history:
+    the first ok read at/after `at_frac` of the history starts returning
+    `bogus` (default: one past the largest int value seen anywhere in
+    the history, so it is provably outside the write domain), making the
+    read impossible to linearize. Returns (corrupted history, event
+    index of the bad read).
+
+    Drives the time-to-first-anomaly benchmark (BASELINE.md names the
+    metric; the reference's knossos surfaces its counterexample through
+    the same invalid-read shape, knossos.model/cas-register)."""
+    events = list(hist)
+    if bogus is None:
+        seen = [e.value for e in events if isinstance(e.value, int)]
+        seen += [v for e in events if isinstance(e.value, (list, tuple))
+                 for v in e.value if isinstance(v, int)]
+        bogus = max(seen, default=98) + 1
+    start = int(len(events) * at_frac)
+    for i in range(start, len(events)):
+        e = events[i]
+        if e.type == "ok" and e.f == "read":
+            events[i] = e.copy(value=bogus)
+            return History(events, assign_indices=False), i
+    raise ValueError("no ok read at/after at_frac to corrupt")
+
+
 def list_append_history(n_txns: int, n_procs: int = 5, n_keys: int = 6,
                         max_len: int = 4, rotate: int = 40,
                         seed: int = 0) -> History:

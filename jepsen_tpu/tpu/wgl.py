@@ -884,6 +884,47 @@ def check_segmented(enc: Encoded, target_len: int | None = None,
 # Public analysis API (knossos-analysis-shaped results)
 # ---------------------------------------------------------------------------
 
+# Below this many entries the whole-history kernel/host search is cheap
+# enough that segment-localized witness extraction isn't worth a launch.
+SEGMENT_MIN_M = 4096
+
+
+def _seg_kwargs(W: int | None, F: int | None, **extra) -> dict:
+    """check_segmented kwargs: only overrides the leaner segmented
+    defaults (W=24/F=48) when the caller tuned W/F explicitly."""
+    kw = dict(extra)
+    if W is not None:
+        kw["W"] = W
+    if F is not None:
+        kw["F"] = F
+    return kw
+
+
+def extract_witness(enc: Encoded, W: int | None = None,
+                    F: int | None = None) -> dict:
+    """Bounded witness extraction for a history the device kernel
+    flagged INVALID or UNKNOWN.
+
+    For long histories, localizes the FIRST failing segment by
+    reach-mask composition (one batched device launch over
+    segment x start-state rows) and host-searches only that segment —
+    replacing the whole-history `search_host` fallback whose cost is
+    unbounded at 1M-op scale (the anomaly path the reference pays hours
+    for, jepsen/src/jepsen/checker.clj:202-233). Small or unsegmentable
+    histories fall through to the exact whole-history host search.
+
+    Sets result["witness-extraction"] to 'segmented' or 'host' so
+    callers (and tests) can see which path ran."""
+    if enc.m >= SEGMENT_MIN_M:
+        seg = check_segmented(enc, witness=True, **_seg_kwargs(W, F))
+        if seg is not None:
+            seg["witness-extraction"] = "segmented"
+            return seg
+    out = search_host(enc, witness=True)
+    out["witness-extraction"] = "host"
+    return out
+
+
 def analysis(model, hist, algorithm: str = "tpu", W: int | None = None,
              F: int | None = None, checkpoint_path=None,
              checkpoint_dir=None) -> dict:
@@ -918,12 +959,8 @@ def analysis(model, hist, algorithm: str = "tpu", W: int | None = None,
     # W/F default per path: the prefix-screened segmented search runs
     # leaner (24/48, unknowns fall back soundly) than the whole-history
     # kernel (32/64).
-    if enc.m >= 4096:
-        seg_kw = {}
-        if W is not None:
-            seg_kw["W"] = W
-        if F is not None:
-            seg_kw["F"] = F
+    if enc.m >= SEGMENT_MIN_M:
+        seg_kw = _seg_kwargs(W, F)
         if checkpoint_path is not None:
             seg_kw["checkpoint_path"] = checkpoint_path
         if checkpoint_dir is not None:
@@ -953,7 +990,8 @@ def analysis(model, hist, algorithm: str = "tpu", W: int | None = None,
 
 
 def analysis_batch_streamed(model, hists: Sequence, chunk: int = 256,
-                            W: int = 32, F: int = 64) -> list[dict]:
+                            W: int | None = None,
+                            F: int | None = None) -> list[dict]:
     """analysis_batch with host->HBM pipelining (SURVEY P7): histories
     are encoded and launched chunk by chunk, and because JAX dispatch
     is asynchronous, chunk i+1's host-side encoding overlaps chunk i's
@@ -981,7 +1019,11 @@ def analysis_batch_streamed(model, hists: Sequence, chunk: int = 256,
         try:
             pb = PackedBatch(encs)
             rows = [(j, e.init_state) for j, e in enumerate(encs)]
-            return _launch(pb, rows, W, F, reach=False), encs, idx_map
+            return (_launch(pb, rows,
+                            W if W is not None else 32,
+                            F if F is not None else 64,
+                            reach=False),
+                    encs, idx_map)
         except RangeError:
             return None, encs, idx_map
 
@@ -994,7 +1036,10 @@ def analysis_batch_streamed(model, hists: Sequence, chunk: int = 256,
             if r == VALID:
                 results[i] = {"valid?": True, "analyzer": "tpu"}
             else:
-                out = search_host(encs[j], witness=True)
+                # Bounded: long invalid/unknown members are localized
+                # segment-wise instead of re-searched whole on host,
+                # keeping the caller's W/F tuning.
+                out = extract_witness(encs[j], W=W, F=F)
                 out["analyzer"] = ("tpu" if r == INVALID
                                    else "tpu+host-fallback")
                 results[i] = out
@@ -1012,8 +1057,8 @@ def analysis_batch_streamed(model, hists: Sequence, chunk: int = 256,
     return results
 
 
-def analysis_batch(model, hists: Sequence, W: int = 32,
-                   F: int = 64) -> list[dict]:
+def analysis_batch(model, hists: Sequence, W: int | None = None,
+                   F: int | None = None) -> list[dict]:
     """Checks many histories at once (the ensemble path: one device
     launch for the whole batch, host fallback only for UNKNOWNs)."""
     hists = list(hists)

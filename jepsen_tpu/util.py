@@ -9,6 +9,7 @@ nemesis-intervals util.clj:780).
 from __future__ import annotations
 
 import math
+import random
 import threading
 import time as _time
 from concurrent.futures import ThreadPoolExecutor
@@ -233,6 +234,17 @@ def name_str(x: Any) -> str:
     return str(x)
 
 
+def seeded_rng(seed, *key):
+    """A `random.Random` deterministically derived from (seed, *key).
+
+    Uses a string seed (CPython seeds str via SHA-512) so replays are
+    stable across processes regardless of PYTHONHASHSEED; a None seed
+    yields a nondeterministic generator, matching every workload's
+    "no seed = fresh randomness" convention.
+    """
+    return random.Random(None if seed is None else repr((seed,) + key))
+
+
 def majority(n: int) -> int:
     """Smallest majority of n nodes (util.clj)."""
     return n // 2 + 1
@@ -247,9 +259,8 @@ def minority_third(n: int) -> int:
 def random_nonempty_subset(coll, rng: Any = None) -> list | None:
     """A randomly selected, randomly ordered, non-empty subset; None for
     an empty collection (util.clj:51-56)."""
-    import random as _random
 
-    rng = rng or _random
+    rng = rng or random
     coll = list(coll)
     if not coll:
         return None
@@ -263,9 +274,8 @@ def rand_distribution(dist_map: dict | None = None, rng: Any = None):
     {'distribution': 'geometric', 'p': 1e-3} |
     {'distribution': 'one-of', 'values': [...]} |
     {'distribution': 'weighted', 'weights': {value: weight, ...}}"""
-    import random as _random
 
-    rng = rng or _random
+    rng = rng or random
     d = dict(dist_map or {})
     kind = d.get("distribution", "uniform")
     if kind == "uniform":

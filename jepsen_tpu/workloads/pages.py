@@ -20,7 +20,7 @@ Client contract (per key, via independent tuples):
 
 from __future__ import annotations
 
-import random
+from .. import util
 
 from .. import checker as chk
 from .. import generator as gen
@@ -97,7 +97,7 @@ def key_gen(k, opts: dict):
     o = opts
     group_size = o.get("elements_per_add", 4)
     n = o.get("elements", 10_000)
-    rng = random.Random((o.get("seed"), k).__hash__())
+    rng = util.seeded_rng(o.get("seed"), k)
     pool = list(range(-n, n))
     rng.shuffle(pool)
     groups = [pool[i:i + group_size]

@@ -8,7 +8,7 @@ model, per-key generators r/w/cas).
 
 from __future__ import annotations
 
-import random
+from .. import util
 
 from .. import checker as chk
 from .. import independent
@@ -29,7 +29,7 @@ def cas(rng, n=5):
 
 def key_gen(k, ops_per_key=100, seed=None):
     """Mixed r/w/cas ops for one key."""
-    rng = random.Random(None if seed is None else (seed, k).__hash__())
+    rng = util.seeded_rng(seed, k)
 
     def one():
         return rng.choice([r, w, cas])(rng)

@@ -26,7 +26,7 @@ Shapes (times are unix-epoch seconds, floats):
 
 from __future__ import annotations
 
-import random
+from .. import util
 
 from .. import checker as chk
 from .. import generator as gen
@@ -161,11 +161,15 @@ class _AddJobGen(gen.Generator):
 
     def __init__(self, head_start: float = 10.0, seed=None, n: int = 0):
         self.head_start = head_start
-        self.seed = seed
+        # (seed, n) -> spec must be stable across probe-and-discard
+        # re-derivations, so an unseeded run draws ONE random seed here
+        # and threads it through every successor.
+        self.seed = (util.seeded_rng(None).randrange(2 ** 63)
+                     if seed is None else seed)
         self.n = n
 
     def op(self, test, ctx):
-        rng = random.Random((self.seed, self.n).__hash__())
+        rng = util.seeded_rng(self.seed, self.n)
         duration = rng.randrange(10)
         epsilon = 10 + rng.randrange(20)
         interval = (1 + duration + epsilon + EPSILON_FORGIVENESS

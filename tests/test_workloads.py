@@ -161,10 +161,22 @@ class TestWorkloadsEndToEnd:
         assert t["results"]["valid?"] is True, t["results"]
 
     def test_registry_complete(self):
-        assert set(workloads.REGISTRY) == {
+        # Core workload families must stay registered; new families may be
+        # added freely (assert subset, not equality, so registrations don't
+        # silently break the suite).
+        core = {
             "adya-g2", "bank", "causal", "causal-reverse", "counter", "dirty-read",
             "kafka", "long-fork", "monotonic", "sequential", "queue", "register", "set",
-            "set-full", "append", "wr", "unique-ids"}
+            "set-full", "append", "wr", "unique-ids",
+            "lock", "fenced-lock", "owner-lock", "reentrant-lock", "semaphore",
+            "upsert", "run-coverage", "pages", "multimonotonic", "lost-updates",
+            "version-divergence"}
+        assert core <= set(workloads.REGISTRY), core - set(workloads.REGISTRY)
+        # Every registered workload must build a test map with a generator
+        # and a checker from default-ish opts.
+        for name, fn in workloads.REGISTRY.items():
+            w = fn({"ops": 10})
+            assert "generator" in w and "checker" in w, name
 
 
 class TestBankCheckFast:
