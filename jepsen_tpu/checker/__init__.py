@@ -243,18 +243,48 @@ class Linearizable(Checker):
             # a DIRECTORY: each check derives a per-fingerprint file,
             # so concurrent per-key/composed checkers never collide
             ckpt_dir = Path(test["store_dir"]) / "checker-frontier"
-        return self._trim(wgl.analysis(self.model, hist,
-                                       algorithm=self.algorithm,
-                                       checkpoint_dir=ckpt_dir))
+        out = self._trim(wgl.analysis(self.model, hist,
+                                      algorithm=self.algorithm,
+                                      checkpoint_dir=ckpt_dir))
+        return self._explain(test, out)
+
+    @staticmethod
+    def _explain(test, out: dict) -> dict:
+        """Invalid + store dir: render the counterexample SVG (the
+        reference's knossos render-analysis! hook, checker.clj:222-229).
+        The filename carries a content fingerprint so concurrent
+        per-key checks sharing one store dir never clobber or
+        mis-attribute each other's renders."""
+        store_dir = isinstance(test, dict) and test.get("store_dir")
+        if store_dir and out.get("valid?") is False:
+            try:
+                from pathlib import Path
+
+                from ..reports import explain
+
+                fp = explain._fingerprint(
+                    (repr(out.get("op")), repr(out.get("previous-ok"))))
+                p = explain.render_linear_svg(
+                    out, Path(store_dir)
+                    / f"linear-counterexample-{fp}.svg")
+                if p:
+                    out["counterexample-svg"] = p
+            except Exception:  # noqa: BLE001 — rendering is best-effort
+                import logging
+
+                logging.getLogger(__name__).exception(
+                    "rendering linear counterexample failed")
+        return out
 
     def check_batch(self, test, hists, opts=None) -> list[dict]:
         from ..tpu import wgl
 
         if self.algorithm != "tpu":
-            return [self._trim(wgl.analysis(self.model, hh,
-                                            algorithm=self.algorithm))
+            return [self._explain(test, self._trim(
+                        wgl.analysis(self.model, hh,
+                                     algorithm=self.algorithm)))
                     for hh in hists]
-        return [self._trim(a) for a in
+        return [self._explain(test, self._trim(a)) for a in
                 wgl.analysis_batch(self.model, hists)]
 
 

@@ -15,13 +15,34 @@ from . import Checker, _Fn
 from ..tpu import elle
 
 
+def _with_artifacts(test, result: dict) -> dict:
+    """On an invalid result with a store directory, writes the elle/
+    anomaly files + cycle plots (the reference passes :directory to
+    elle so it drops the same artifacts, append.clj:17-27)."""
+    store_dir = isinstance(test, dict) and test.get("store_dir")
+    if store_dir and result.get("anomalies"):
+        try:
+            from ..reports import explain
+
+            paths = explain.write_elle_artifacts(store_dir, result)
+            if paths:
+                result = dict(result)
+                result["artifacts"] = paths
+        except Exception:  # noqa: BLE001 — artifacts are best-effort
+            import logging
+
+            logging.getLogger(__name__).exception(
+                "writing elle artifacts failed")
+    return result
+
+
 def append_checker(opts: dict | None = None) -> Checker:
     """Checks list-append histories via the elle-equivalent engine
     (append.clj:11-27)."""
     o = dict(opts or {})
 
     def run(test, hist, copts):
-        return elle.check_list_append(hist, o)
+        return _with_artifacts(test, elle.check_list_append(hist, o))
 
     return _Fn(run)
 
@@ -31,7 +52,7 @@ def wr_checker(opts: dict | None = None) -> Checker:
     o = dict(opts or {})
 
     def run(test, hist, copts):
-        return elle.check_rw_register(hist, o)
+        return _with_artifacts(test, elle.check_rw_register(hist, o))
 
     return _Fn(run)
 

@@ -33,18 +33,19 @@ def _build_dir() -> Path:
     return d
 
 
-def _compile() -> Path | None:
-    # Cache keyed on source CONTENT: mtime comparisons break when a
-    # stale .so outlives a package upgrade (archive mtimes can sort
-    # older), and loading one without the newer symbols would brick
-    # the whole codec for the process
+def _compile_src(srcs, stem: str, extra_args=()) -> Path | None:
+    """Compile C sources to a content-hash-named .so in the build
+    cache. Cache keyed on source CONTENT: mtime comparisons break when
+    a stale .so outlives a package upgrade (archive mtimes can sort
+    older), and loading one without the newer symbols would brick the
+    whole codec for the process."""
     import hashlib
 
     digest = hashlib.sha256()
-    for s in _SRCS:
+    for s in srcs:
         digest.update(s.read_bytes())
     build = _build_dir()
-    out = build / f"jlog-{digest.hexdigest()[:16]}.so"
+    out = build / f"{stem}-{digest.hexdigest()[:16]}.so"
     if out.exists():
         return out
     for cc in ("cc", "gcc", "g++"):
@@ -52,11 +53,13 @@ def _compile() -> Path | None:
         # killed compile (or a concurrent process — _LOCK is
         # thread-local) must never leave a half-written .so at the
         # cache path, where it would be trusted forever
-        tmp = build / f".jlog-{os.getpid()}.so.tmp"
+        tmp = build / f".{stem}-{os.getpid()}.so.tmp"
         try:
+            # extra_args trail the sources: -l libraries must follow
+            # the objects that use them under --as-needed linkers
             proc = subprocess.run(
-                [cc, "-O2", "-shared", "-fPIC", *map(str, _SRCS),
-                 "-o", str(tmp), "-lz"],
+                [cc, "-O2", "-shared", "-fPIC", *map(str, srcs),
+                 "-o", str(tmp), *extra_args],
                 capture_output=True, text=True, timeout=120)
         except (OSError, subprocess.TimeoutExpired):
             tmp.unlink(missing_ok=True)
@@ -64,13 +67,18 @@ def _compile() -> Path | None:
         if proc.returncode == 0:
             os.replace(tmp, out)
             # prune superseded builds (incl. the legacy fixed name)
-            for old in build.glob("jlog*.so"):
+            for old in build.glob(f"{stem}*.so"):
                 if old != out:
                     old.unlink(missing_ok=True)
             return out
         tmp.unlink(missing_ok=True)
-        logger.debug("%s failed to build jlog.so: %s", cc, proc.stderr)
+        logger.debug("%s failed to build %s.so: %s", cc, stem,
+                     proc.stderr)
     return None
+
+
+def _compile() -> Path | None:
+    return _compile_src(_SRCS, "jlog", extra_args=("-lz",))
 
 
 def jlog() -> ctypes.CDLL | None:
@@ -140,6 +148,105 @@ def frame(payloads: list[bytes]) -> bytes:
     out = ctypes.create_string_buffer(len(blob) + 8 * len(payloads))
     written = lib.jlog_frame(blob, lens, len(payloads), out)
     return out.raw[:written]
+
+
+# ---------------------------------------------------------------------------
+# elleflat: C-API flattener for the elle device engine
+# ---------------------------------------------------------------------------
+
+_EF_SRC = Path(__file__).with_name("elleflat.c")
+_ef_lib = None
+_ef_tried = False
+
+# field ids — must match elleflat.c's enum
+EF_APPEND_FIELDS = ("t_type", "t_proc", "t_inv", "t_comp", "t_opidx",
+                    "ap_txn", "ap_key", "ap_val",
+                    "rd_txn", "rd_key", "rd_len", "re_vals", "flag_rd")
+EF_RW_FIELDS = ("t_type", "t_proc", "t_inv", "t_comp", "t_opidx",
+                "wr_txn", "wr_key", "wr_val", "wr_nonfinal",
+                "rd_txn", "rd_key", "rd_val",
+                "fr_txn", "fr_key", "fr_prev", "fr_new",
+                "er_txn", "er_key", "er_val", "int_row", "int_expected")
+
+
+def _compile_ef() -> Path | None:
+    import sysconfig
+
+    inc = sysconfig.get_paths().get("include")
+    if not inc:
+        return None
+    return _compile_src((_EF_SRC,), "elleflat",
+                        extra_args=(f"-I{inc}",))
+
+
+def elleflat() -> ctypes.PyDLL | None:
+    """The compiled flattener (PyDLL: it calls the CPython C-API under
+    the GIL), or None — callers use the Python flattening path."""
+    global _ef_lib, _ef_tried
+    if _ef_lib is not None or _ef_tried:
+        return _ef_lib
+    with _LOCK:
+        if _ef_lib is not None or _ef_tried:
+            return _ef_lib
+        _ef_tried = True
+        try:
+            path = _compile_ef()
+            if path is None:
+                return None
+            lib = ctypes.PyDLL(str(path))
+            lib.ef_flatten.restype = ctypes.c_void_p
+            lib.ef_flatten.argtypes = [ctypes.py_object, ctypes.c_int64]
+            lib.ef_status.restype = ctypes.c_int64
+            lib.ef_status.argtypes = [ctypes.c_void_p]
+            lib.ef_len.restype = ctypes.c_int64
+            lib.ef_len.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+            lib.ef_copy.restype = None
+            lib.ef_copy.argtypes = [ctypes.c_void_p, ctypes.c_int64,
+                                    ctypes.POINTER(ctypes.c_int64)]
+            lib.ef_keys.restype = ctypes.py_object
+            lib.ef_keys.argtypes = [ctypes.c_void_p]
+            lib.ef_free.restype = None
+            lib.ef_free.argtypes = [ctypes.c_void_p]
+            _ef_lib = lib
+        except Exception:  # noqa: BLE001 — flattening has a Python path
+            logger.exception("loading native elleflat failed")
+            _ef_lib = None
+        return _ef_lib
+
+
+class NotVectorizable(Exception):
+    """The native flattener found non-int values / too many keys."""
+
+
+def elle_flatten(ops: list, kind: int) -> tuple[dict, list]:
+    """One C pass over a history's op list. kind 0 = list-append,
+    1 = rw-register. Returns ({field: int64 array}, key list); raises
+    RuntimeError if the native flattener is unavailable and
+    NotVectorizable when the history can't take the int fast path."""
+    import numpy as np
+
+    lib = elleflat()
+    if lib is None:
+        raise RuntimeError("native elleflat unavailable")
+    h = lib.ef_flatten(ops, kind)
+    if not h:
+        raise RuntimeError("native elleflat failed")
+    try:
+        if lib.ef_status(h):
+            raise NotVectorizable()
+        fields = EF_RW_FIELDS if kind else EF_APPEND_FIELDS
+        out = {}
+        p = ctypes.POINTER(ctypes.c_int64)
+        for fid, name in enumerate(fields):
+            n = lib.ef_len(h, fid)
+            arr = np.empty(n, dtype=np.int64)
+            if n:
+                lib.ef_copy(h, fid, arr.ctypes.data_as(p))
+            out[name] = arr
+        keys = lib.ef_keys(h)
+        return out, keys
+    finally:
+        lib.ef_free(h)
 
 
 def realtime_edges(inv, comp):

@@ -267,7 +267,7 @@ def order_edge_arrays(committed: list[Txn]):
     with O(n * concurrency) edges instead of O(n^2). Returns int
     (src, dst, type) arrays; the single implementation behind both the
     host and device engines. Process chains are a lexsort; the sweep
-    runs in C (native/order.c) with this Python loop as fallback."""
+    runs in C (native/order.c) with a Python loop as fallback."""
     n = len(committed)
     if n == 0:
         e = np.empty(0, dtype=np.int64)
@@ -282,6 +282,17 @@ def order_edge_arrays(committed: list[Txn]):
     procid = np.fromiter(
         (proc_ids.setdefault(t.process, len(proc_ids))
          for t in committed), dtype=np.int64, count=n)
+    return order_edges_from_arrays(ids, inv, comp, procid)
+
+
+def order_edges_from_arrays(ids, inv, comp, procid):
+    """Array-native core of order_edge_arrays: txn ids, invoke and
+    complete history positions, and per-txn process codes (any ints
+    that equal iff the process is the same)."""
+    n = len(ids)
+    if n == 0:
+        e = np.empty(0, dtype=np.int64)
+        return e, e.copy(), e.copy()
     # session order: adjacent pairs within each process
     order = np.lexsort((inv, procid))
     same = procid[order][1:] == procid[order][:-1]
@@ -292,9 +303,9 @@ def order_edge_arrays(committed: list[Txn]):
         from .. import native
 
         r_src_i, r_dst_i = native.realtime_edges(inv, comp)
-        r_src, r_dst = ids[r_src_i], ids[r_dst_i]
     except RuntimeError:
-        r_src, r_dst = _realtime_edges_py(committed)
+        r_src_i, r_dst_i = _realtime_edges_arrays_py(inv, comp)
+    r_src, r_dst = ids[r_src_i], ids[r_dst_i]
     src = np.concatenate([p_src, r_src])
     dst = np.concatenate([p_dst, r_dst])
     ty = np.concatenate([np.full(len(p_src), PROC, dtype=np.int64),
@@ -302,28 +313,29 @@ def order_edge_arrays(committed: list[Txn]):
     return src, dst, ty
 
 
-def _realtime_edges_py(committed: list[Txn]):
-    """Pure-Python frontier sweep (the C path's reference semantics).
-    On a completion, drop frontier members the completing txn already
-    covers; on an invocation, link every frontier member in."""
+def _realtime_edges_arrays_py(inv, comp):
+    """Pure-Python frontier sweep (the C path's reference semantics),
+    over dense row indices. On a completion, drop frontier members the
+    completing txn already covers; on an invocation, link every
+    frontier member in."""
     src: list[int] = []
     dst: list[int] = []
     events = []
-    for t in committed:
-        events.append((t.invoke_pos, 1, t))
-        events.append((t.complete_pos, 0, t))
-    events.sort(key=lambda e: (e[0], e[1]))
-    frontier: list[Txn] = []
-    for _pos, is_inv, t in events:
+    for i in range(len(inv)):
+        events.append((int(inv[i]), 1, i))
+        events.append((int(comp[i]), 0, i))
+    events.sort()
+    frontier: list[int] = []
+    for _pos, is_inv, i in events:
         if is_inv:
             for a in frontier:
-                if a.i != t.i:
-                    src.append(a.i)
-                    dst.append(t.i)
+                if a != i:
+                    src.append(a)
+                    dst.append(i)
         else:
             frontier[:] = [y for y in frontier
-                           if y.complete_pos >= t.invoke_pos]
-            frontier.append(t)
+                           if int(comp[y]) >= int(inv[i])]
+            frontier.append(i)
     return (np.asarray(src, dtype=np.int64),
             np.asarray(dst, dtype=np.int64))
 

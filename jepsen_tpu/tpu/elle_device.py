@@ -32,7 +32,7 @@ from .. import history as h
 from ..history import History
 from . import scc as scc_mod
 from .elle import (EDGE_NAMES, PROC, RT, RW, WR, WW, Txn, _classify,
-                   _find_cycle, collect, order_edge_arrays)
+                   _find_cycle, collect, order_edges_from_arrays)
 
 _TYPE_OK, _TYPE_INFO, _TYPE_FAIL = 0, 1, 2
 _T_CODE = {h.OK: _TYPE_OK, h.INFO: _TYPE_INFO, h.FAIL: _TYPE_FAIL}
@@ -45,8 +45,68 @@ class Unvectorizable(Exception):
     """History can't take the int-array fast path."""
 
 
+def _txn_mops(ops: list, arrs: dict, ti: int):
+    """A txn's effective micro-ops, mirroring collect(): the completion
+    value for committed txns (unless None), else the invocation's."""
+    op = ops[int(arrs["t_opidx"][ti])]
+    if int(arrs["t_type"][ti]) == _TYPE_OK and op.value is not None:
+        return op.value
+    return ops[int(arrs["t_inv"][ti])].value or []
+
+
+def _internal_from_flags(ops: list, arrs: dict) -> list[tuple]:
+    """Replays the own-append suffix rule for the (rare) reads the C
+    flattener flagged: a committed read of a key the same txn appended
+    to earlier must end with the txn's own appends, in order."""
+    out: list[tuple] = []
+    flags = arrs["flag_rd"]
+    if not len(flags):
+        return out
+    for ti in np.unique(arrs["rd_txn"][flags]):
+        op = ops[int(arrs["t_opidx"][ti])]
+        own: dict = {}
+        for mop in _txn_mops(ops, arrs, int(ti)):
+            f, k, v = mop[0], mop[1], mop[2]
+            if f == "append":
+                own.setdefault(k, []).append(v)
+            elif f == "r" and v is not None:
+                vs = list(v)
+                pre = own.get(k)
+                if pre and vs[-len(pre):] != pre:
+                    out.append((int(ti), k, {
+                        "key": k, "expected-suffix": list(pre),
+                        "read": vs, "op": op}))
+    return out
+
+
 class Flat:
-    """Dense-array view of a list-append history."""
+    """Dense-array view of a list-append history. Two constructors:
+    the Python loop over collected Txn objects (reference semantics),
+    and from_native() over the C flattener's arrays (native/elleflat.c,
+    one C pass over the raw op list — the fast path; differential
+    tests pin the two to identical arrays)."""
+
+    @classmethod
+    def from_native(cls, ops: list, arrs: dict, keys: list) -> "Flat":
+        self = cls.__new__(cls)
+        self.n = len(arrs["t_type"])
+        self.t_type = arrs["t_type"].astype(np.int8)
+        self.t_inv = arrs["t_inv"]
+        self.t_comp = arrs["t_comp"]
+        self.t_proc = arrs["t_proc"]
+        self.t_opidx = arrs["t_opidx"]
+        self.key_names = keys
+        for f in ("ap_txn", "ap_key", "ap_val", "rd_txn", "rd_key",
+                  "rd_len", "re_vals"):
+            setattr(self, f, arrs[f])
+        self.rd_off = np.concatenate(
+            [[0], np.cumsum(self.rd_len)])[:-1].astype(np.int64)
+        self.re_read = np.repeat(np.arange(len(self.rd_txn)),
+                                 self.rd_len)
+        # The C pass flags reads whose txn appended the same key
+        # earlier; only those few txns replay the own-suffix rule here.
+        self.internal_bad = _internal_from_flags(ops, arrs)
+        return self
 
     def __init__(self, txns: list[Txn]):
         self.txns = txns
@@ -56,6 +116,12 @@ class Flat:
                                   dtype=np.int8, count=n)
         self.t_inv = np.fromiter((t.invoke_pos for t in txns),
                                  dtype=np.int64, count=n)
+        self.t_comp = np.fromiter((t.complete_pos for t in txns),
+                                  dtype=np.int64, count=n)
+        proc_ids: dict = {}
+        self.t_proc = np.fromiter(
+            (proc_ids.setdefault(t.process, len(proc_ids))
+             for t in txns), dtype=np.int64, count=n)
 
         key_ids: dict = {}
         ap_txn: list[int] = []
@@ -121,17 +187,46 @@ def _pack(keys: np.ndarray, vals: np.ndarray) -> np.ndarray:
 
 
 class DeviceAppendAnalysis:
-    """Mirrors elle.AppendAnalysis over Flat arrays."""
+    """Mirrors elle.AppendAnalysis over Flat arrays. Flattening runs
+    through the C pass (native.elle_flatten) when available; txn/op
+    objects materialize lazily, only for anomaly witnesses."""
+
+    _KIND = 0
+    _FLAT_CLS = Flat
 
     def __init__(self, hist: History, device: bool = True):
         self.device = device
-        self.txns = collect(hist)
-        self.flat = Flat(self.txns)
+        self._ops = list(hist)
+        self.txns: list[Txn] | None = None
+        self.flat = self._flatten(hist)
         self.anomalies: dict[str, list] = defaultdict(list)
         self._resolve_writers()
         self._spines()
         self._read_anomalies()
         self.edge_src, self.edge_dst, self.edge_ty = self._edges()
+
+    def _flatten(self, hist: History):
+        from .. import native
+
+        try:
+            arrs, keys = native.elle_flatten(self._ops, self._KIND)
+            return self._FLAT_CLS.from_native(self._ops, arrs, keys)
+        except native.NotVectorizable as e:
+            raise Unvectorizable(str(e)) from e
+        except RuntimeError:
+            self.txns = collect(hist)
+            return self._FLAT_CLS(self.txns)
+
+    def _op(self, ti: int):
+        """The witness op for txn row ti (lazy: no Txn objects on the
+        native path)."""
+        if self.txns is not None:
+            return self.txns[int(ti)].op
+        return self._ops[int(self.flat.t_opidx[int(ti)])]
+
+    @property
+    def n(self) -> int:
+        return self.flat.n
 
     # -- writers -----------------------------------------------------------
 
@@ -200,10 +295,10 @@ class DeviceAppendAnalysis:
                 first_of = np.ones(srt.size, dtype=bool)
                 first_of[1:] = pid_s[1:] != pid_s[:-1]
                 for row in srt[~first_of]:
-                    t = self.txns[f.ap_txn[row]]
                     self.anomalies["duplicate-appends"].append({
                         "key": f.key_names[f.ap_key[row]],
-                        "value": int(f.ap_val[row]), "op": t.op})
+                        "value": int(f.ap_val[row]),
+                        "op": self._op(f.ap_txn[row])})
         # possibly-committed writer txns per key (for empty-read rw)
         nf_k = f.ap_key[nonfail]
         nf_t = f.ap_txn[nonfail]
@@ -283,7 +378,6 @@ class DeviceAppendAnalysis:
             bad = too_long.copy()
             np.logical_or.at(bad, f.re_read, mismatch)
             for r in np.flatnonzero(bad):
-                t = self.txns[f.rd_txn[r]]
                 o, n_ = int(f.rd_off[r]), int(f.rd_len[r])
                 k = int(f.rd_key[r])
                 so, sl = int(self.sp_off[k]), int(self.spine_len[k])
@@ -291,7 +385,7 @@ class DeviceAppendAnalysis:
                     "key": f.key_names[k],
                     "read": f.re_vals[o:o + n_].tolist(),
                     "spine": self.sp_vals[so:so + sl].tolist(),
-                    "op": t.op})
+                    "op": self._op(f.rd_txn[r])})
 
     # -- read anomalies ----------------------------------------------------
 
@@ -306,20 +400,18 @@ class DeviceAppendAnalysis:
         unobs = re_w < 0
         for i in np.flatnonzero(unobs):
             r = f.re_read[i]
-            t = self.txns[f.rd_txn[r]]
             self.anomalies["unobservable-read"].append({
                 "key": f.key_names[f.rd_key[r]],
-                "value": int(f.re_vals[i]), "op": t.op})
+                "value": int(f.re_vals[i]), "op": self._op(f.rd_txn[r])})
         aborted = np.zeros(len(re_pid), dtype=bool)
         if len(self.w_txn):
             aborted[~unobs] = self.w_fail[re_pid[~unobs]]
         for i in np.flatnonzero(aborted):
             r = f.re_read[i]
-            t = self.txns[f.rd_txn[r]]
-            wt = self.txns[self.w_txn[re_pid[i]]]
             self.anomalies["G1a"].append({
                 "key": f.key_names[f.rd_key[r]],
-                "value": int(f.re_vals[i]), "op": t.op, "writer": wt.op})
+                "value": int(f.re_vals[i]), "op": self._op(f.rd_txn[r]),
+                "writer": self._op(self.w_txn[re_pid[i]])})
         # G1b: last element is an intermediate version of another txn
         nz = np.flatnonzero(f.rd_len > 0)
         last_idx = f.rd_off[nz] + f.rd_len[nz] - 1
@@ -336,12 +428,11 @@ class DeviceAppendAnalysis:
             (self.w_txn[wi] != f.rd_txn[nz])
         for i in np.flatnonzero(g1b):
             r = nz[i]
-            t = self.txns[f.rd_txn[r]]
-            wt = self.txns[self.w_txn[last_pid[i]]]
             o = int(f.rd_off[r] + f.rd_len[r] - 1)
             self.anomalies["G1b"].append({
                 "key": f.key_names[f.rd_key[r]],
-                "value": int(f.re_vals[o]), "op": t.op, "writer": wt.op})
+                "value": int(f.re_vals[o]), "op": self._op(f.rd_txn[r]),
+                "writer": self._op(self.w_txn[last_pid[i]])})
         for _ti, _kid, rec in f.internal_bad:
             self.anomalies["internal"].append(rec)
 
@@ -431,8 +522,9 @@ class DeviceAppendAnalysis:
         # session order + realtime: the host engine's sweep, shared
         comm = np.flatnonzero(self.flat.t_type == _TYPE_OK)
         if comm.size:
-            o_src, o_dst, o_ty = order_edge_arrays(
-                [self.txns[i] for i in comm])
+            fl = self.flat
+            o_src, o_dst, o_ty = order_edges_from_arrays(
+                comm, fl.t_inv[comm], fl.t_comp[comm], fl.t_proc[comm])
             if o_src.size:
                 srcs.append(o_src)
                 dsts.append(o_dst)
@@ -456,7 +548,10 @@ _SUBSETS = ((WW,), (WW, WR), (WW, WR, RW), (WW, WR, RW, PROC),
 def cycle_anomalies_arrays(n: int, src, dst, ty, txns,
                            device: bool = True) -> dict[str, list]:
     """elle.cycle_anomalies over edge arrays: SCCs per cumulative edge
-    subset via the device kernel, witnesses extracted host-side."""
+    subset via the device kernel, witnesses extracted host-side. txns
+    is either a Txn list or a callable ti -> witness op (the lazy
+    accessor of the native flattening path)."""
+    op_of = txns if callable(txns) else (lambda i: txns[i].op)
     out: dict[str, list] = defaultdict(list)
     if not len(src):
         return out
@@ -492,7 +587,7 @@ def cycle_anomalies_arrays(n: int, src, dst, ty, txns,
                 continue
             name = _classify(cycle)
             out[name].append({
-                "cycle": [txns[a].op for a, _b, _c in cycle],
+                "cycle": [op_of(a) for a, _b, _c in cycle],
                 "steps": [{"from": a, "to": b, "type": EDGE_NAMES[c]}
                           for a, b, c in cycle]})
     return out
@@ -506,7 +601,7 @@ def check_list_append_device(hist, device: bool = True) -> dict:
     a = DeviceAppendAnalysis(hist, device=device)
     anomalies = dict(a.anomalies)
     for name, ws in cycle_anomalies_arrays(
-            len(a.txns), a.edge_src, a.edge_dst, a.edge_ty, a.txns,
+            a.flat.n, a.edge_src, a.edge_dst, a.edge_ty, a._op,
             device=device).items():
         anomalies[name] = ws
     return {
@@ -514,7 +609,7 @@ def check_list_append_device(hist, device: bool = True) -> dict:
         "anomaly-types": sorted(anomalies.keys()),
         "anomalies": {k: v[:8] for k, v in anomalies.items()},
         "edge-count": int(len(a.edge_src)),
-        "txn-count": len(a.txns),
+        "txn-count": a.flat.n,
     }
 
 
@@ -530,11 +625,42 @@ class RwFlat:
     anomalies; everything downstream is numpy over packed (key, value)
     codes."""
 
+    @classmethod
+    def from_native(cls, ops: list, arrs: dict, keys: list) -> "RwFlat":
+        self = cls.__new__(cls)
+        self.n = len(arrs["t_type"])
+        self.t_type = arrs["t_type"].astype(np.int8)
+        self.t_inv = arrs["t_inv"]
+        self.t_comp = arrs["t_comp"]
+        self.t_proc = arrs["t_proc"]
+        self.t_opidx = arrs["t_opidx"]
+        self.key_names = keys
+        for f in ("wr_txn", "wr_key", "wr_val", "wr_nonfinal",
+                  "rd_txn", "rd_key", "rd_val",
+                  "fr_txn", "fr_key", "fr_prev", "fr_new",
+                  "er_txn", "er_key", "er_val"):
+            setattr(self, f, arrs[f])
+        # internal anomalies: the C pass records (read row, expected)
+        self.internal_bad = [
+            {"key": keys[int(self.rd_key[r])],
+             "expected": int(e), "read": int(self.rd_val[r]),
+             "op": ops[int(arrs["t_opidx"][self.rd_txn[r]])]}
+            for r, e in zip(arrs["int_row"], arrs["int_expected"])]
+        return self
+
     def __init__(self, txns: list[Txn]):
         self.txns = txns
         n = len(txns)
         self.t_type = np.fromiter((_T_CODE[t.type] for t in txns),
                                   dtype=np.int8, count=n)
+        self.t_inv = np.fromiter((t.invoke_pos for t in txns),
+                                 dtype=np.int64, count=n)
+        self.t_comp = np.fromiter((t.complete_pos for t in txns),
+                                  dtype=np.int64, count=n)
+        proc_ids: dict = {}
+        self.t_proc = np.fromiter(
+            (proc_ids.setdefault(t.process, len(proc_ids))
+             for t in txns), dtype=np.int64, count=n)
         key_ids: dict = {}
         wr_txn: list[int] = []
         wr_key: list[int] = []
@@ -631,6 +757,7 @@ class RwFlat:
         self.er_key = np.asarray(er_key, dtype=np.int64)
         self.er_val = np.asarray(er_val, dtype=np.int64)
         self.internal_bad = internal_bad
+        self.n = n
 
 
 class DeviceRwAnalysis:
@@ -642,15 +769,22 @@ class DeviceRwAnalysis:
 
     CAP = 8
 
+    _KIND = 1
+    _FLAT_CLS = None  # set after RwFlat below
+
     def __init__(self, hist: History, device: bool = True):
-        self.txns = collect(hist)
         self.device = device
+        self._ops = list(hist)
+        self.txns: list[Txn] | None = None
+        f = self.flat = self._flatten(hist)
         self.anomalies: dict[str, list] = defaultdict(list)
-        f = self.flat = RwFlat(self.txns)
         for rec in f.internal_bad:
             self.anomalies["internal"].append(rec)
         self._resolve_writers()
         self._read_anomalies_and_edges()
+
+    _flatten = DeviceAppendAnalysis._flatten
+    _op = DeviceAppendAnalysis._op
 
     def _resolve_writers(self):
         f = self.flat
@@ -686,10 +820,10 @@ class DeviceRwAnalysis:
                 first = np.ones(srt.size, dtype=bool)
                 first[1:] = pid_s[1:] != pid_s[:-1]
                 for row in srt[~first][:self.CAP]:
-                    t = self.txns[f.wr_txn[row]]
                     self.anomalies["duplicate-writes"].append({
                         "key": f.key_names[f.wr_key[row]],
-                        "value": int(f.wr_val[row]), "op": t.op})
+                        "value": int(f.wr_val[row]),
+                        "op": self._op(f.wr_txn[row])})
         # intermediate (non-final) writer per pair: last row in txn
         # order wins, like the host's dict overwrite
         self.inter_txn = np.full(P, -1, dtype=np.int64)
@@ -728,7 +862,7 @@ class DeviceRwAnalysis:
                 self.anomalies["unobservable-read"].append({
                     "key": f.key_names[f.rd_key[i]],
                     "value": int(f.rd_val[i]),
-                    "op": self.txns[f.rd_txn[i]].op})
+                    "op": self._op(f.rd_txn[i])})
             found = ~missing
             if len(self.pair_codes):
                 wt = np.where(found,
@@ -743,8 +877,8 @@ class DeviceRwAnalysis:
                 self.anomalies["G1a"].append({
                     "key": f.key_names[f.rd_key[i]],
                     "value": int(f.rd_val[i]),
-                    "op": self.txns[f.rd_txn[i]].op,
-                    "writer": self.txns[wt[i]].op})
+                    "op": self._op(f.rd_txn[i]),
+                    "writer": self._op(wt[i])})
             ext = found & ~wfail & (wt != f.rd_txn)
             inter = np.where(found,
                              self.inter_txn[np.clip(pid, 0, None)], -1)
@@ -753,8 +887,8 @@ class DeviceRwAnalysis:
                 self.anomalies["G1b"].append({
                     "key": f.key_names[f.rd_key[i]],
                     "value": int(f.rd_val[i]),
-                    "op": self.txns[f.rd_txn[i]].op,
-                    "writer": self.txns[inter[i]].op})
+                    "op": self._op(f.rd_txn[i]),
+                    "writer": self._op(inter[i])})
             emit(wt[ext], f.rd_txn[ext], WR)
 
         # -- write-follows-read: ww edges + version succession
@@ -791,8 +925,10 @@ class DeviceRwAnalysis:
                  & (f.t_type[np.clip(w2, 0, None)] == _TYPE_OK))
             emit(f.er_txn[m], w2[m], RW)
 
-        committed = [t for t in self.txns if t.type == h.OK]
-        o_src, o_dst, o_ty = order_edge_arrays(committed)
+        fl = self.flat
+        comm = np.flatnonzero(fl.t_type == _TYPE_OK)
+        o_src, o_dst, o_ty = order_edges_from_arrays(
+            comm, fl.t_inv[comm], fl.t_comp[comm], fl.t_proc[comm])
         src.append(o_src)
         dst.append(o_dst)
         ty.append(o_ty)
@@ -804,6 +940,9 @@ class DeviceRwAnalysis:
             np.empty(0, dtype=np.int64)
 
 
+DeviceRwAnalysis._FLAT_CLS = RwFlat
+
+
 def check_rw_register_device(hist, device: bool = True) -> dict:
     """Drop-in device-path analog of elle.check_rw_register. Raises
     Unvectorizable when the history can't be interned."""
@@ -812,7 +951,7 @@ def check_rw_register_device(hist, device: bool = True) -> dict:
     a = DeviceRwAnalysis(hist, device=device)
     anomalies = dict(a.anomalies)
     for name, ws in cycle_anomalies_arrays(
-            len(a.txns), a.edge_src, a.edge_dst, a.edge_ty, a.txns,
+            a.flat.n, a.edge_src, a.edge_dst, a.edge_ty, a._op,
             device=device).items():
         anomalies[name] = ws
     return {
@@ -820,5 +959,5 @@ def check_rw_register_device(hist, device: bool = True) -> dict:
         "anomaly-types": sorted(anomalies.keys()),
         "anomalies": {k: v[:8] for k, v in anomalies.items()},
         "edge-count": int(len(a.edge_src)),
-        "txn-count": len(a.txns),
+        "txn-count": a.flat.n,
     }

@@ -58,8 +58,14 @@ def _next_pow2(x: int) -> int:
 
 
 @lru_cache(maxsize=None)
-def _jitted_round(n_pad: int, e_pad: int, sweep_cap: int):
-    """One compiled Orzan round per (node, edge) shape bucket."""
+def _jitted_scc(n_pad: int, e_pad: int, sweep_cap: int,
+                round_cap: int):
+    """The ENTIRE Orzan peeling loop as one compiled launch per
+    (node, edge) shape bucket: one host->device upload of the edge
+    list, rounds and fixpoints run in nested lax.while_loops, one
+    download of (labels, ok). On a tunneled TPU the per-transfer
+    latency dominates sweep compute by orders of magnitude, so
+    round-trips — not FLOPs — are the budget."""
     import jax
     import jax.numpy as jnp
 
@@ -103,7 +109,38 @@ def _jitted_round(n_pad: int, e_pad: int, sweep_cap: int):
         return labels, jnp.logical_and(active, ~member), \
             jnp.logical_and(ok_f, ok_b)
 
-    return jax.jit(one_round)
+    def full(active0, src, dst, edge_on):
+        def cond(state):
+            active, _out, ok, rounds = state
+            return ok & jnp.any(active) & (rounds < round_cap)
+
+        def body(state):
+            active, out, ok, rounds = state
+            labels, new_active, converged = one_round(active, src, dst,
+                                                      edge_on)
+            return (new_active, jnp.where(labels >= 0, labels, out),
+                    ok & converged, rounds + 1)
+
+        out0 = jnp.full((n_pad,), -1, dtype=jnp.int32)
+        active, out, ok, _ = jax.lax.while_loop(
+            cond, body, (active0, out0, jnp.bool_(True), jnp.int32(0)))
+        done = ok & jnp.logical_not(jnp.any(active))
+        # ok flag rides IN the labels array (slot n_pad-1 is sentinel
+        # territory): one device->host transfer instead of two — each
+        # transfer pays full link latency on a tunneled TPU.
+        return out.at[-1].set(done.astype(jnp.int32))
+
+    return jax.jit(full)
+
+
+def _edge_pad(e: int) -> int:
+    """Edge shape buckets: multiples of 128Ki (capped pow2 below that)
+    rather than next-pow2 — the padding is uploaded over the (slow)
+    host->device link, so a 600k-edge graph shouldn't ship 1M slots."""
+    if e <= (1 << 17):
+        return _next_pow2(max(e, 1))
+    step = 1 << 17
+    return ((e + step - 1) // step) * step
 
 
 def scc_device(n: int, src, dst, emask=None) -> np.ndarray | None:
@@ -118,7 +155,7 @@ def scc_device(n: int, src, dst, emask=None) -> np.ndarray | None:
     if n == 0:
         return np.empty(0, dtype=np.int32)
     n_pad = _next_pow2(n + 1)
-    e_pad = _next_pow2(max(len(src), 1))
+    e_pad = _edge_pad(len(src))
     # pad edges as self-loops on the sentinel (inactive) node n
     psrc = np.full(e_pad, n, dtype=np.int32)
     pdst = np.full(e_pad, n, dtype=np.int32)
@@ -126,22 +163,14 @@ def scc_device(n: int, src, dst, emask=None) -> np.ndarray | None:
     pdst[:len(dst)] = dst
     pmask = np.zeros(e_pad, dtype=bool)
     pmask[:len(src)] = True if emask is None else np.asarray(emask)
-    fn = _jitted_round(n_pad, e_pad, SWEEP_CAP)
-    psrc, pdst, pmask = (jnp.asarray(x) for x in (psrc, pdst, pmask))
-
+    fn = _jitted_scc(n_pad, e_pad, SWEEP_CAP, ROUND_CAP)
     active = np.zeros(n_pad, dtype=bool)
     active[:n] = True
-    out = np.full(n_pad, -1, dtype=np.int32)
-    for _ in range(ROUND_CAP):
-        labels, new_active, converged = (np.asarray(x) for x in fn(
-            jnp.asarray(active), psrc, pdst, pmask))
-        if not bool(converged):
-            return None
-        out = np.where(labels >= 0, labels, out)
-        active = new_active
-        if not active.any():
-            return out[:n]
-    return None
+    labels = np.asarray(fn(jnp.asarray(active), jnp.asarray(psrc),
+                           jnp.asarray(pdst), jnp.asarray(pmask)))
+    if not labels[-1]:  # convergence flag (see _jitted_scc)
+        return None
+    return labels[:n]
 
 
 def _scc_host(n: int, src, dst) -> np.ndarray:

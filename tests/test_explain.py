@@ -1,0 +1,90 @@
+"""Explanation artifacts: elle anomaly files + cycle plots and the
+linearizability counterexample SVG (reference behavior:
+append.clj:17-27 passes :directory to elle; checker.clj:222-229 calls
+knossos.linear.report/render-analysis!)."""
+
+from jepsen_tpu import checker
+from jepsen_tpu.checker import cycle as cyc, models
+from jepsen_tpu.history import History, op
+from jepsen_tpu.reports import explain
+from jepsen_tpu.tpu import elle
+
+
+def T(*events):
+    return History([op(type=t, process=p, f="txn", value=m)
+                    for t, p, m in events])
+
+
+def _g0_history():
+    return T(("invoke", 0, [["append", "x", 1], ["append", "y", 1]]),
+             ("invoke", 1, [["append", "x", 2], ["append", "y", 2]]),
+             ("ok", 0, [["append", "x", 1], ["append", "y", 1]]),
+             ("ok", 1, [["append", "x", 2], ["append", "y", 2]]),
+             ("invoke", 2, [["r", "x", None], ["r", "y", None]]),
+             ("ok", 2, [["r", "x", [1, 2]], ["r", "y", [2, 1]]]))
+
+
+def _bad_register_history():
+    return History([
+        op(type="invoke", process=0, f="write", value=1),
+        op(type="ok", process=0, f="write", value=1),
+        op(type="invoke", process=1, f="read", value=None),
+        op(type="ok", process=1, f="read", value=2),
+    ])
+
+
+class TestElleArtifacts:
+    def test_write_artifacts(self, tmp_path):
+        res = elle.check_list_append(_g0_history())
+        assert res["valid?"] is False
+        paths = explain.write_elle_artifacts(tmp_path, res)
+        assert paths
+        elle_dir = tmp_path / "elle"
+        txts = list(elle_dir.glob("*.txt"))
+        assert any(p.stem.startswith("G0-") for p in txts), txts
+        # cycle plot + dot text for the G0 cycle
+        svgs = list(elle_dir.glob("cycle-*.svg"))
+        assert svgs
+        assert "<svg" in svgs[0].read_text()
+        dot = next(iter(elle_dir.glob("cycles-*.dot"))).read_text()
+        assert "->" in dot and "digraph" in dot
+
+    def test_valid_result_writes_nothing(self, tmp_path):
+        paths = explain.write_elle_artifacts(
+            tmp_path, {"valid?": True, "anomalies": {}})
+        assert paths == []
+        assert not (tmp_path / "elle").exists()
+
+    def test_checker_integration(self, tmp_path):
+        c = cyc.append_checker()
+        test = {"store_dir": str(tmp_path)}
+        res = c.check(test, _g0_history())
+        assert res["valid?"] is False
+        assert res.get("artifacts")
+        assert (tmp_path / "elle").is_dir()
+        assert list((tmp_path / "elle").glob("*.txt"))
+
+
+class TestLinearCounterexample:
+    def test_render_svg(self, tmp_path):
+        c = checker.linearizable({"model": models.cas_register()})
+        res = c.check({}, _bad_register_history())
+        assert res["valid?"] is False
+        p = explain.render_linear_svg(res, tmp_path / "ce.svg")
+        assert p is not None
+        body = (tmp_path / "ce.svg").read_text()
+        assert "<svg" in body and "unlinearizable" in body
+
+    def test_valid_renders_nothing(self, tmp_path):
+        assert explain.render_linear_svg(
+            {"valid?": True}, tmp_path / "x.svg") is None
+        assert not (tmp_path / "x.svg").exists()
+
+    def test_checker_integration(self, tmp_path):
+        c = checker.linearizable({"model": models.cas_register()})
+        test = {"store_dir": str(tmp_path)}
+        res = c.check(test, _bad_register_history())
+        assert res["valid?"] is False
+        assert res.get("counterexample-svg")
+        svgs = list(tmp_path.glob("linear-counterexample-*.svg"))
+        assert svgs and res["counterexample-svg"] == str(svgs[0])
