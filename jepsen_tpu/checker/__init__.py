@@ -263,7 +263,8 @@ class Linearizable(Checker):
                 from ..reports import explain
 
                 fp = explain._fingerprint(
-                    (repr(out.get("op")), repr(out.get("previous-ok"))))
+                    (repr(out.get("op")), repr(out.get("previous-ok")),
+                     repr(out.get("configs"))))
                 p = explain.render_linear_svg(
                     out, Path(store_dir)
                     / f"linear-counterexample-{fp}.svg")

@@ -45,6 +45,19 @@ class Unvectorizable(Exception):
     """History can't take the int-array fast path."""
 
 
+def _dense_first_seen(xs: np.ndarray) -> np.ndarray:
+    """Raw ids -> dense codes in FIRST-SEEN order, matching the
+    Python flattener's process interning dict."""
+    if not len(xs):
+        return xs
+    _u, first, inv = np.unique(xs, return_index=True,
+                               return_inverse=True)
+    order = np.argsort(first, kind="stable")
+    rank = np.empty(len(order), dtype=np.int64)
+    rank[order] = np.arange(len(order))
+    return rank[inv]
+
+
 def _txn_mops(ops: list, arrs: dict, ti: int):
     """A txn's effective micro-ops, mirroring collect(): the completion
     value for committed txns (unless None), else the invocation's."""
@@ -93,7 +106,7 @@ class Flat:
         self.t_type = arrs["t_type"].astype(np.int8)
         self.t_inv = arrs["t_inv"]
         self.t_comp = arrs["t_comp"]
-        self.t_proc = arrs["t_proc"]
+        self.t_proc = _dense_first_seen(arrs["t_proc"])
         self.t_opidx = arrs["t_opidx"]
         self.key_names = keys
         for f in ("ap_txn", "ap_key", "ap_val", "rd_txn", "rd_key",
@@ -632,7 +645,7 @@ class RwFlat:
         self.t_type = arrs["t_type"].astype(np.int8)
         self.t_inv = arrs["t_inv"]
         self.t_comp = arrs["t_comp"]
-        self.t_proc = arrs["t_proc"]
+        self.t_proc = _dense_first_seen(arrs["t_proc"])
         self.t_opidx = arrs["t_opidx"]
         self.key_names = keys
         for f in ("wr_txn", "wr_key", "wr_val", "wr_nonfinal",
@@ -770,7 +783,7 @@ class DeviceRwAnalysis:
     CAP = 8
 
     _KIND = 1
-    _FLAT_CLS = None  # set after RwFlat below
+    _FLAT_CLS = RwFlat
 
     def __init__(self, hist: History, device: bool = True):
         self.device = device
@@ -939,8 +952,6 @@ class DeviceRwAnalysis:
         self.edge_ty = np.concatenate(ty) if ty else \
             np.empty(0, dtype=np.int64)
 
-
-DeviceRwAnalysis._FLAT_CLS = RwFlat
 
 
 def check_rw_register_device(hist, device: bool = True) -> dict:

@@ -1,0 +1,309 @@
+"""Dgraph suite tests: daemon orchestration via the dummy remote, an
+in-memory dgraph (upsert blocks + snapshot txns with first-committer-
+wins conflicts), and clusterless e2e runs of the workload menu —
+healthy and with seeded upsert/index bugs (mirrors
+dgraph/src/jepsen/dgraph/{support,client,upsert,delete}.clj)."""
+
+import itertools
+import threading
+
+from jepsen_tpu import control, core, testing
+from jepsen_tpu import generator as gen
+from jepsen_tpu.control.core import Action
+from jepsen_tpu.control.dummy import DummyRemote
+from jepsen_tpu.history import Op
+from jepsen_tpu.suites import dgraph as dg
+
+
+def make_test(responder=None, nodes=("n1", "n2", "n3")):
+    remote = DummyRemote(responder)
+    t = testing.noop_test()
+    t.update(nodes=list(nodes), remote=remote,
+             sessions={n: remote.connect({"host": n}) for n in nodes})
+    return t
+
+
+def cmds(test, node):
+    return [a for a in test["sessions"][node].log
+            if isinstance(a, Action)]
+
+
+class TestDB:
+    def test_zero_peers_follow_node1(self):
+        test = make_test()
+        db = dg.DgraphDB()
+        with control.with_session(test, "n2"):
+            db._start_zero(test, "n2")
+            db._start_alpha(test, "n2")
+        got = " ; ".join(a.cmd for a in cmds(test, "n2"))
+        assert "zero" in got and "idx=2" in got
+        assert f"--peer n1:{dg.ZERO_PORT}" in got
+        assert "alpha" in got and f"--zero n1:{dg.ZERO_PORT}" in got
+
+    def test_node1_zero_has_no_peer(self):
+        test = make_test()
+        db = dg.DgraphDB()
+        with control.with_session(test, "n1"):
+            db._start_zero(test, "n1")
+        got = " ; ".join(a.cmd for a in cmds(test, "n1"))
+        assert "--peer" not in got and "idx=1" in got
+
+    def test_kill_greps_binaries(self):
+        test = make_test()
+        db = dg.DgraphDB()
+        with control.with_session(test, "n1"):
+            db.kill(test, "n1")
+        got = " ; ".join(a.cmd for a in cmds(test, "n1"))
+        assert "dgraph" in got
+
+
+class FakeDgraph:
+    """In-memory dgraph: records are uid->predicate dicts; upsert
+    blocks are atomic; explicit txns take a snapshot and conflict
+    first-committer-wins on written uids. broken='double-upsert'
+    defeats the insert-unless-exists condition every 3rd call (the
+    duplicate-entity bug upsert.clj exists to catch);
+    broken='dirty-index' leaves the index entry behind on delete."""
+
+    def __init__(self, broken=None):
+        self.lock = threading.Lock()
+        self.broken = broken
+        self.uids = itertools.count(1)
+        self.ghosts: dict = {}    # (pred, key) -> stale index uids
+        self.recs: dict = {}      # uid -> {pred: value}
+        self.version = 0          # bumps on every commit
+        self.write_log: dict = {} # uid -> version last written
+        self.upsert_calls = 0
+
+    # -- semantic interface (DgraphHTTP) --------------------------------
+
+    def alter_schema(self, schema):
+        pass
+
+    def _find(self, pred, key):
+        return [u for u, r in self.recs.items()
+                if str(r.get(pred)) == str(key)]
+
+    def upsert_unless_exists(self, pred, key, extra):
+        with self.lock:
+            self.upsert_calls += 1
+            hit = self._find(pred, key)
+            forced = (self.broken == "double-upsert"
+                      and self.upsert_calls % 3 == 0)
+            if hit and not forced:
+                return None
+            uid = f"0x{next(self.uids):x}"
+            self.recs[uid] = dict(extra, **{pred: key})
+            self.version += 1
+            self.write_log[uid] = self.version
+            return uid
+
+    def delete_where(self, pred, key):
+        with self.lock:
+            hits = self._find(pred, key)
+            for u in hits:
+                if self.broken == "dirty-index":
+                    # record goes, index entry stays: later reads see
+                    # the ghost AND any recreated record (the stale-
+                    # index bug delete.clj hunts)
+                    self.ghosts.setdefault((pred, str(key)),
+                                           []).append(u)
+                del self.recs[u]
+            self.version += 1
+            return len(hits)
+
+    def query_eq(self, pred, key, want=("uid",)):
+        with self.lock:
+            return self._rows(pred, key, want)
+
+    def _rows(self, pred, key, want):
+        out = []
+        for u in self._find(pred, key):
+            row = {}
+            for w in want:
+                if w == "uid":
+                    row["uid"] = u
+                elif w in self.recs[u]:
+                    row[w] = self.recs[u][w]
+            out.append(row)
+        for u in self.ghosts.get((pred, str(key)), []):
+            out.append({"uid": u} if "uid" in want else {})
+        return out
+
+    def write_value(self, pred, key, vpred, value):
+        with self.lock:
+            hits = self._find(pred, key)
+            if hits:
+                u = hits[0]
+            else:
+                u = f"0x{next(self.uids):x}"
+                self.recs[u] = {pred: key}
+            self.recs[u][vpred] = value
+            self.version += 1
+            self.write_log[u] = self.version
+
+    # explicit txns: snapshot + first-committer-wins
+
+    def txn_begin(self):
+        with self.lock:
+            import copy
+
+            return {"snapshot": copy.deepcopy(self.recs),
+                    "start_version": self.version,
+                    "writes": [],     # (uid-or-new, pred, value)
+                    "read_uids": set()}
+
+    def txn_query(self, txn, pred, key, want=("uid",)):
+        # effective view: snapshot + own writes (read-your-writes)
+        import copy
+
+        eff = copy.deepcopy(txn["snapshot"])
+        for uid, p, v in txn["writes"]:
+            rec = eff.setdefault(uid, {})
+            try:
+                rec[p] = int(v)
+            except (TypeError, ValueError):
+                rec[p] = v
+        rows = []
+        for u, r in eff.items():
+            if str(r.get(pred)) == str(key):
+                row = {}
+                for w in want:
+                    row[w] = u if w == "uid" else r.get(w)
+                rows.append({k: v for k, v in row.items()
+                             if v is not None})
+                txn["read_uids"].add(u)
+        return rows
+
+    def txn_set(self, txn, nquads: str):
+        for line in nquads.strip().splitlines():
+            parts = line.strip().rstrip(" .").split(maxsplit=2)
+            subj = parts[0].strip("<>")
+            pred = parts[1].strip("<>")
+            val = parts[2].strip('"')
+            if subj.startswith("_:"):
+                subj = f"new:{subj}:{id(txn)}"
+            txn["writes"].append((subj, pred, val))
+
+    def txn_commit(self, txn):
+        with self.lock:
+            written = {u for u, _p, _v in txn["writes"]
+                       if not u.startswith("new:")}
+            for u in written:
+                if self.write_log.get(u, 0) > txn["start_version"]:
+                    raise dg.TxnConflict(f"uid {u} written since "
+                                         f"ts {txn['start_version']}")
+            self.version += 1
+            renames = {}
+            for u, p, v in txn["writes"]:
+                if u.startswith("new:"):
+                    u = renames.setdefault(
+                        u, f"0x{next(self.uids):x}")
+                rec = self.recs.setdefault(u, {})
+                try:
+                    rec[p] = int(v)
+                except (TypeError, ValueError):
+                    rec[p] = v
+                self.write_log[u] = self.version
+
+
+class FakeHTTPFactory:
+    def __init__(self, state=None):
+        self.state = state or FakeDgraph()
+
+    def __call__(self, test, node, timeout=10.0):
+        return self.state
+
+
+def run_clusterless(workload: dict, concurrency=6) -> dict:
+    t = testing.noop_test()
+    t.update(
+        nodes=["n1", "n2", "n3"],
+        concurrency=concurrency,
+        client=workload["client"],
+        checker=workload["checker"],
+        generator=gen.clients(workload["generator"]))
+    for extra in ("total-amount", "accounts"):
+        if extra in workload:
+            t[extra] = workload[extra]
+    return core.run(t)
+
+
+def _wl(name, state, **opts):
+    w = dg.WORKLOADS[name](dict(opts))
+    w["client"].http_factory = FakeHTTPFactory(state)
+    w["client"].http = state
+    w["client"].setup({})
+    return w
+
+
+class TestWorkloadsEndToEnd:
+    def test_upsert_healthy(self):
+        t = run_clusterless(_wl("upsert", FakeDgraph(),
+                                key_count=4, group_size=3))
+        assert t["results"]["valid?"] is True, t["results"]
+
+    def test_upsert_detects_double_create(self):
+        t = run_clusterless(_wl("upsert", FakeDgraph("double-upsert"),
+                                key_count=4, group_size=3))
+        assert t["results"]["valid?"] is False
+
+    def test_delete_healthy(self):
+        t = run_clusterless(_wl("delete", FakeDgraph(),
+                                key_count=4, seed=5))
+        assert t["results"]["valid?"] is True, t["results"]
+
+    def test_delete_detects_dirty_index(self):
+        t = run_clusterless(_wl("delete", FakeDgraph("dirty-index"),
+                                key_count=3, seed=5,
+                                ops_per_key=40))
+        # leftover index entries accumulate -> some read sees >1 row
+        assert t["results"]["valid?"] is False
+
+    def test_register_linearizable(self):
+        t = run_clusterless(_wl("linearizable-register", FakeDgraph(),
+                                keys=[0, 1, 2], ops_per_key=40,
+                                group_size=3, seed=3))
+        assert t["results"]["valid?"] is True, t["results"]
+
+    def test_set_healthy(self):
+        t = run_clusterless(_wl("set", FakeDgraph(), ops=60))
+        assert t["results"]["valid?"] is True, t["results"]
+
+    def test_sequential(self):
+        t = run_clusterless(_wl("sequential", FakeDgraph(), ops=60))
+        assert t["results"]["valid?"] in (True, "unknown"), \
+            t["results"]
+
+    def test_bank_conserves(self):
+        t = run_clusterless(_wl("bank", FakeDgraph(), ops=80))
+        assert t["results"]["valid?"] is True, t["results"]
+
+    def test_wr_txns(self):
+        t = run_clusterless(_wl("wr", FakeDgraph(), ops=80))
+        assert t["results"]["valid?"] is True, t["results"]
+
+    def test_workload_registry_builds(self):
+        for name, fn in dg.WORKLOADS.items():
+            w = fn({"ops": 5})
+            assert {"generator", "checker", "client"} <= set(w), name
+
+
+class TestTraceClient:
+    def test_spans_written(self, tmp_path):
+        state = FakeDgraph()
+        w = _wl("upsert", state, key_count=2, group_size=2)
+        inner = w["client"]
+        tc = dg.TraceClient(inner, path=str(tmp_path / "trace.jsonl"))
+        c = tc.open({"nodes": ["n1"]}, "n1")
+        c.invoke({}, Op(type="invoke", process=0, f="upsert",
+                        value=(0, None)))
+        c.invoke({}, Op(type="invoke", process=0, f="read",
+                        value=(0, None)))
+        lines = (tmp_path / "trace.jsonl").read_text().splitlines()
+        assert len(lines) == 2
+        import json
+
+        span = json.loads(lines[0])
+        assert span["f"] == "upsert" and span["node"] == "n1"
+        assert span["end"] >= span["start"]

@@ -1,0 +1,263 @@
+"""Hazelcast suite tests: DB orchestration via the dummy remote, a
+scripted FakeHz speaking the client jar's line protocol, and
+clusterless e2e lock/semaphore/cas/queue/id runs — healthy and with
+seeded mutual-exclusion violations (mirrors
+hazelcast/src/jepsen/hazelcast.clj's client + workload map)."""
+
+import threading
+
+import pytest
+
+from jepsen_tpu import control, core, testing
+from jepsen_tpu import generator as gen
+from jepsen_tpu.control.core import Action
+from jepsen_tpu.control.dummy import DummyRemote
+from jepsen_tpu.history import Op
+from jepsen_tpu.suites import hazelcast as hz
+
+
+def make_test(responder=None, nodes=("n1", "n2", "n3")):
+    remote = DummyRemote(responder)
+    t = testing.noop_test()
+    t.update(nodes=list(nodes), remote=remote,
+             sessions={n: remote.connect({"host": n}) for n in nodes})
+    return t
+
+
+def cmds(test, node):
+    return [a for a in test["sessions"][node].log
+            if isinstance(a, Action)]
+
+
+class TestDB:
+    def test_member_config(self):
+        cfg = hz.member_config({"nodes": ["n1", "n2", "n3"]})
+        assert "- n1:5701" in cfg and "- n3:5701" in cfg
+        assert "cp-member-count: 3" in cfg
+        assert "multicast:\n        enabled: false" in cfg
+
+    def test_start_uses_daemon_helpers(self):
+        test = make_test()
+        db = hz.HzDB()
+        with control.with_session(test, "n1"):
+            db.start(test, "n1")
+        got = " ; ".join(a.cmd for a in cmds(test, "n1"))
+        assert "bin/hz" in got and "start" in got
+        assert hz.CONFIG in got
+
+    def test_kill_greps_jvm(self):
+        test = make_test()
+        db = hz.HzDB()
+        with control.with_session(test, "n1"):
+            db.kill(test, "n1")
+        got = " ; ".join(a.cmd for a in cmds(test, "n1"))
+        assert "com.hazelcast" in got
+
+
+class FakeHz:
+    """The client jar's line protocol over in-memory CP structures.
+    broken='steal' grants a busy lock anyway with a STALE fence;
+    broken='overfill' hands out more semaphore permits than exist."""
+
+    def __init__(self, broken=None, permits=2):
+        self.lock = threading.Lock()
+        self.broken = broken
+        self.permits = permits
+        self.locks = {}      # name -> (owner, fence, count)
+        self.fences = {}     # name -> next fence
+        self.sems = {}       # name -> {owner: count}
+        self.longs = {}      # name -> int
+        self.ids = {}        # name -> int
+        self.queues = {}     # name -> list
+        self.attempts = 0
+
+    def cmd(self, session, line: str) -> str:
+        with self.lock:
+            return self._dispatch(session, line.split())
+
+    def _dispatch(self, who, parts):
+        kind = parts[0]
+        if kind == "lock":
+            return self._lock(who, parts[1], parts[2])
+        if kind == "sem":
+            return self._sem(who, parts[1], parts[2])
+        if kind == "long":
+            return self._long(parts[1:])
+        if kind == "id":
+            n = self.ids.get(parts[2], 0)
+            self.ids[parts[2]] = n + 1
+            return f"OK {n}"
+        if kind == "q":
+            q = self.queues.setdefault(parts[2], [])
+            if parts[1] == "offer":
+                q.append(int(parts[3]))
+                return "OK"
+            if not q:
+                return "EMPTY"
+            return f"OK {q.pop(0)}"
+        return f"ERR unknown {kind}"
+
+    def _lock(self, who, f, name):
+        owner, fence, count = self.locks.get(name, (None, 0, 0))
+        if f == "acquire":
+            self.attempts += 1
+            if owner is None or owner == who:
+                nf = fence if owner == who else \
+                    self.fences.setdefault(name, 0) + 1
+                self.fences[name] = nf
+                self.locks[name] = (who, nf, count + 1)
+                return f"OK {nf}"
+            if self.broken == "steal" and self.attempts % 3 == 0:
+                # grants with the PREVIOUS holder's fence: stale token
+                self.locks[name] = (who, fence, 1)
+                return f"OK {fence}"
+            return "BUSY"
+        if owner != who:
+            return "ERR not-owner"
+        if count <= 1:
+            self.locks[name] = (None, fence, 0)
+        else:
+            self.locks[name] = (owner, fence, count - 1)
+        return "OK"
+
+    def _sem(self, who, f, name):
+        held = self.sems.setdefault(name, {})
+        total = sum(held.values())
+        limit = self.permits + (1 if self.broken == "overfill" else 0)
+        if f == "acquire":
+            if total < limit:
+                held[who] = held.get(who, 0) + 1
+                return "OK"
+            return "BUSY"
+        if held.get(who, 0) > 0:
+            held[who] -= 1
+            return "OK"
+        return "ERR not-permit-owner"
+
+    def _long(self, parts):
+        f, name = parts[0], parts[1]
+        v = self.longs.get(name, 0)
+        if f == "read":
+            return f"OK {v}"
+        if f == "write":
+            self.longs[name] = int(parts[2])
+            return "OK"
+        a, b = int(parts[2]), int(parts[3])
+        if v == a:
+            self.longs[name] = b
+            return "OK"
+        return "FAIL"
+
+
+class FakeConsoleFactory:
+    """console_factory plug for the suite's clients: each opened
+    console is a distinct CP session (keyed by node+instance)."""
+
+    def __init__(self, state=None):
+        self.state = state or FakeHz()
+        self._n = 0
+
+    def __call__(self, test, node, timeout=10.0):
+        self._n += 1
+        factory, session = self, f"{node}#{self._n}"
+
+        class _Console:
+            def cmd(self, line):
+                return factory.state.cmd(session, line)
+
+        return _Console()
+
+
+def run_clusterless(workload: dict, nodes=3, concurrency=6) -> dict:
+    t = testing.noop_test()
+    t.update(
+        nodes=[f"n{i}" for i in range(nodes)],
+        concurrency=concurrency,
+        client=workload["client"],
+        checker=workload["checker"],
+        generator=gen.clients(workload["generator"]))
+    return core.run(t)
+
+
+class TestWorkloadsEndToEnd:
+    def _wl(self, name, state, **opts):
+        w = hz.WORKLOADS[name](dict({"ops": 60, "stagger": 0}, **opts))
+        fac = FakeConsoleFactory(state)
+        w["client"].console_factory = fac
+        return w
+
+    def test_lock_healthy(self):
+        t = run_clusterless(self._wl("lock", FakeHz()))
+        assert t["results"]["valid?"] is True, t["results"]
+
+    def test_fenced_lock_detects_steal(self):
+        # per-process sessions make the steal a two-holder violation
+        t = run_clusterless(
+            self._wl("fenced-lock", FakeHz(broken="steal")))
+        assert t["results"]["valid?"] is False
+
+    def test_semaphore_healthy_and_overfilled(self):
+        t = run_clusterless(self._wl("semaphore", FakeHz()))
+        assert t["results"]["valid?"] is True, t["results"]
+        t = run_clusterless(
+            self._wl("semaphore", FakeHz(broken="overfill")))
+        assert t["results"]["valid?"] is False
+
+    def test_cas_long(self):
+        t = run_clusterless(self._wl("cas-long", FakeHz(), ops=50))
+        assert t["results"]["valid?"] is True, t["results"]
+
+    def test_id_gen_unique(self):
+        t = run_clusterless(self._wl("id-gen", FakeHz(), ops=50))
+        assert t["results"]["valid?"] is True, t["results"]
+
+    def test_queue(self):
+        t = run_clusterless(self._wl("queue", FakeHz(), ops=40))
+        assert t["results"]["valid?"] is True, t["results"]
+
+    def test_workload_registry_builds(self):
+        for name, fn in hz.WORKLOADS.items():
+            w = fn({"ops": 5})
+            assert {"generator", "checker", "client"} <= set(w), name
+
+
+class TestClientProtocol:
+    def _client(self, cls, state=None, **kw):
+        fac = FakeConsoleFactory(state)
+        c = cls(console_factory=fac, **kw)
+        return c.open({"nodes": ["n1"]}, "n1"), fac.state
+
+    def test_lock_fence_monotonic_across_holders(self):
+        c1, state = self._client(hz.LockClient)
+        r1 = c1.invoke({}, Op(type="invoke", process=0, f="acquire",
+                              value=None))
+        assert r1.type == "ok" and r1.value["fence"] == 1
+        assert c1.invoke({}, Op(type="invoke", process=0, f="release",
+                                value=None)).type == "ok"
+        r2 = c1.invoke({}, Op(type="invoke", process=0, f="acquire",
+                              value=None))
+        assert r2.value["fence"] == 2
+
+    def test_busy_lock_fails(self):
+        state = FakeHz()
+        fac = FakeConsoleFactory(state)
+        c1 = hz.LockClient(console_factory=fac).open(
+            {"nodes": ["n1"]}, "n1")
+        c2 = hz.LockClient(console_factory=fac).open(
+            {"nodes": ["n1"]}, "n1")
+        assert c1.invoke({}, Op(type="invoke", process=0, f="acquire",
+                                value=None)).type == "ok"
+        assert c2.invoke({}, Op(type="invoke", process=1, f="acquire",
+                                value=None)).type == "fail"
+
+    def test_cas_long_semantics(self):
+        c, _ = self._client(hz.CasLongClient)
+        assert c.invoke({}, Op(type="invoke", process=0, f="write",
+                               value=3)).type == "ok"
+        r = c.invoke({}, Op(type="invoke", process=0, f="read",
+                            value=None))
+        assert r.value == 3
+        assert c.invoke({}, Op(type="invoke", process=0, f="cas",
+                               value=[3, 4])).type == "ok"
+        assert c.invoke({}, Op(type="invoke", process=0, f="cas",
+                               value=[3, 4])).type == "fail"

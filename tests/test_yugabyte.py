@@ -1,0 +1,319 @@
+"""Yugabyte suite tests: master/tserver orchestration via the dummy
+remote, a scripted ysql/ycql runner executing the clients' statement
+shapes, and clusterless e2e runs across the API-parameterized workload
+matrix — healthy and with seeded bugs (mirrors
+yugabyte/src/yugabyte/core.clj's workload matrix)."""
+
+import re
+import threading
+
+from jepsen_tpu import control, core, testing
+from jepsen_tpu import generator as gen
+from jepsen_tpu.control.core import Action, RemoteError
+from jepsen_tpu.control.dummy import DummyRemote
+from jepsen_tpu.history import Op
+from jepsen_tpu.suites import yugabyte as yb
+
+
+def make_test(responder=None, nodes=("n1", "n2", "n3")):
+    remote = DummyRemote(responder)
+    t = testing.noop_test()
+    t.update(nodes=list(nodes), remote=remote,
+             sessions={n: remote.connect({"host": n}) for n in nodes})
+    return t
+
+
+def cmds(test, node):
+    return [a for a in test["sessions"][node].log
+            if isinstance(a, Action)]
+
+
+class TestDB:
+    def test_daemons_wired_to_all_masters(self):
+        test = make_test()
+        db = yb.YbDB()
+        with control.with_session(test, "n2"):
+            db._start_master(test, "n2")
+            db._start_tserver(test, "n2")
+        got = " ; ".join(a.cmd for a in cmds(test, "n2"))
+        assert "yb-master" in got and "yb-tserver" in got
+        assert f"n1:{yb.MASTER_PORT},n2:{yb.MASTER_PORT}," \
+               f"n3:{yb.MASTER_PORT}" in got
+        assert "--replication_factor 3" in got
+        assert "--start_pgsql_proxy" in got
+
+    def test_kill_greps_both(self):
+        test = make_test()
+        db = yb.YbDB()
+        with control.with_session(test, "n1"):
+            db.kill(test, "n1")
+        got = " ; ".join(a.cmd for a in cmds(test, "n1"))
+        assert "yb-master" in got and "yb-tserver" in got
+
+
+class _SqlError(Exception):
+    pass
+
+
+class FakeYb:
+    """Executes the statement shapes the suite's clients emit, over
+    in-memory tables with a global lock (statements and BEGIN..COMMIT
+    blocks are atomic — a serializable-by-construction store).
+    broken='null-default' makes ALTER..DEFAULT leave existing rows
+    NULL (the DDL race default_value.clj hunts);
+    broken='lost-update' drops every 5th UPDATE silently."""
+
+    def __init__(self, broken=None):
+        self.lock = threading.Lock()
+        self.broken = broken
+        self.tables: dict = {}   # name -> {pk: {col: val}}
+        self.columns: dict = {}  # name -> [cols]
+        self.serial: dict = {}
+        self.updates = 0
+
+    def run(self, stmt: str) -> str:
+        with self.lock:
+            out = []
+            for s in stmt.split(";"):
+                s = s.strip()
+                if not s or s.upper().startswith(("BEGIN", "COMMIT")):
+                    continue
+                r = self._one(s)
+                if r:
+                    out.append(r)
+            return "\n".join(out) + ("\n" if out else "")
+
+    # -- statement shapes ------------------------------------------------
+
+    def _one(self, s: str) -> str:
+        u = s.upper()
+        if u.startswith("CREATE TABLE"):
+            m = re.search(r"CREATE TABLE IF NOT EXISTS (\w+)\s*\((.*)\)",
+                          s, re.I | re.S)
+            name, cols = m.group(1), m.group(2)
+            self.tables.setdefault(name, {})
+            self.columns.setdefault(
+                name, [c.strip().split()[0] for c in cols.split(",")])
+            return ""
+        if u.startswith("CREATE INDEX"):
+            return ""
+        if u.startswith("ALTER TABLE"):
+            m = re.search(r"ALTER TABLE (\w+) ADD COLUMN IF NOT EXISTS "
+                          r"(\w+) INT NOT NULL DEFAULT (\d+)", s, re.I)
+            t, col, d = m.group(1), m.group(2), int(m.group(3))
+            if col not in self.columns[t]:
+                self.columns[t].append(col)
+                for row in self.tables[t].values():
+                    row[col] = None if self.broken == "null-default" \
+                        else d
+            return ""
+        if u.startswith("INSERT INTO"):
+            return self._insert(s)
+        if u.startswith("UPDATE"):
+            return self._update(s)
+        if u.startswith("SELECT"):
+            return self._select(s)
+        raise AssertionError(f"fake yb can't parse: {s!r}")
+
+    def _insert(self, s: str) -> str:
+        m = re.search(r"INSERT INTO (\w+) \(([^)]*)\) VALUES "
+                      r"\(([^)]*)\)(?:\s+ON CONFLICT \((\w+)\) DO "
+                      r"(NOTHING|UPDATE SET (\w+) = ('?[\w,]+'?)))?",
+                      s, re.I)
+        if m is None:
+            m2 = re.search(r"INSERT INTO (\w+) DEFAULT VALUES", s, re.I)
+            t = m2.group(1)
+            pk = self.serial[t] = self.serial.get(t, 0) + 1
+            row = {"id": pk}
+            for c in self.columns[t][1:]:
+                row[c] = 0  # server-side default fills new rows
+            self.tables[t][pk] = row
+            return ""
+        t, cols, vals = m.group(1), m.group(2), m.group(3)
+        cols = [c.strip() for c in cols.split(",")]
+        vals = [v.strip().strip("'") for v in vals.split(",")]
+        row = dict(zip(cols, [self._coerce(v) for v in vals]))
+        pk = row[cols[0]]
+        exists = pk in self.tables[t]
+        if exists:
+            if m.group(5) and m.group(5).upper() == "NOTHING":
+                return ""
+            if m.group(6):  # DO UPDATE SET col = v
+                self.tables[t][pk][m.group(6)] = self._coerce(
+                    m.group(7).strip("'"))
+                return ""
+            raise _SqlError(f"duplicate key {pk}")
+        self.tables[t][pk] = row
+        return ""
+
+    def _coerce(self, v):
+        try:
+            return int(v)
+        except (TypeError, ValueError):
+            return v
+
+    def _update(self, s: str) -> str:
+        self.updates += 1
+        if self.broken == "lost-update" and self.updates % 5 == 0:
+            m = re.search(r"RETURNING", s, re.I)
+            return "0" if m else ""
+        m = re.search(
+            r"UPDATE (\w+) SET (\w+) = (.+?) WHERE (\w+) = "
+            r"('?\w+'?)(?:\s+AND (\w+) = (\w+))?"
+            r"(?:\s+RETURNING (\w+))?$", s, re.I)
+        t, col, expr = m.group(1), m.group(2), m.group(3)
+        pk = self._coerce(m.group(5).strip("'"))
+        rows = self.tables.get(t, {})
+        if pk not in rows:
+            return "" if not m.group(8) else ""
+        row = rows[pk]
+        if m.group(6) and row.get(m.group(6)) != self._coerce(
+                m.group(7)):
+            return ""  # guard failed: 0 rows
+        am = re.match(rf"{col} ([+-]) (\d+)", expr.strip())
+        if am:
+            delta = int(am.group(2))
+            row[col] = (row.get(col) or 0) + (
+                delta if am.group(1) == "+" else -delta)
+        elif expr.strip().startswith(f"{t}.{col} ||"):
+            suffix = re.search(r"\|\| ',?(\d+)'", expr).group(1)
+            row[col] = f"{row[col]},{suffix}"
+        else:
+            row[col] = self._coerce(expr.strip().strip("'"))
+        return str(row[col]) if m.group(8) else ""
+
+    def _select(self, s: str) -> str:
+        m = re.search(r"SELECT (.+?) FROM (\w+)"
+                      r"(?:\s+WHERE (\w+) = ('?\w+'?))?"
+                      r"(?:\s+ORDER BY .*)?$", s, re.I)
+        want, t = m.group(1).strip(), m.group(2)
+        rows = list(self.tables.get(t, {}).values())
+        if m.group(3):
+            pk = self._coerce(m.group(4).strip("'"))
+            rows = [r for r in rows if r.get(m.group(3)) == pk]
+        out = []
+        for r in rows:
+            if want == "*":
+                cells = [("" if r.get(c) is None else str(r.get(c)))
+                         for c in self.columns[t]]
+                out.append("|".join(cells))
+            else:
+                v = r.get(want)
+                if v is not None:
+                    out.append(str(v))
+        return "\n".join(out)
+
+
+class FakeRunnerFactory:
+    dialect = "fake"
+
+    def __init__(self, state=None):
+        self.state = state or FakeYb()
+
+    def __call__(self, test, node, timeout=10.0):
+        factory = self
+
+        class _R:
+            dialect = "fake"
+
+            def run(self, stmt):
+                try:
+                    return factory.state.run(stmt)
+                except _SqlError as e:
+                    raise RemoteError("sql failed", exit=1, out="",
+                                      err=str(e), cmd="sql",
+                                      node=node)
+
+            def close(self):
+                pass
+
+        return _R()
+
+
+def run_clusterless(workload: dict, concurrency=6) -> dict:
+    t = testing.noop_test()
+    t.update(
+        nodes=["n1", "n2", "n3"],
+        concurrency=concurrency,
+        client=workload["client"],
+        checker=workload["checker"],
+        generator=gen.clients(workload["generator"]))
+    for extra in ("total-amount", "accounts"):
+        if extra in workload:
+            t[extra] = workload[extra]
+    return core.run(t)
+
+
+def _wl(name, state, **opts):
+    w, _ = yb.workload_for(name, dict(opts))
+    w["client"].runner_factory = FakeRunnerFactory(state)
+    w["client"].runner = state
+    w["client"].setup({})
+    return w
+
+
+class TestWorkloadsEndToEnd:
+    def test_counter(self):
+        fake = FakeYb()
+        w = _wl("ysql/counter", fake, ops=60)
+        w["client"].runner = FakeRunnerFactory(fake)(None, "n1")
+        w["client"].setup({})
+        t = run_clusterless(w)
+        assert t["results"]["valid?"] is True, t["results"]
+
+    def test_set(self):
+        t = run_clusterless(_wl("ysql/set", FakeYb(), ops=60))
+        assert t["results"]["valid?"] is True, t["results"]
+
+    def test_bank_conserves(self):
+        t = run_clusterless(_wl("ysql/bank", FakeYb(), ops=80))
+        assert t["results"]["valid?"] is True, t["results"]
+
+    def test_bank_multitable(self):
+        t = run_clusterless(_wl("ysql/bank-multitable", FakeYb(),
+                                ops=80))
+        assert t["results"]["valid?"] is True, t["results"]
+
+    def test_bank_detects_lost_updates(self):
+        t = run_clusterless(_wl("ysql/bank",
+                                FakeYb(broken="lost-update"),
+                                ops=80))
+        assert t["results"]["valid?"] is False
+
+    def test_single_key_acid(self):
+        t = run_clusterless(_wl("ysql/single-key-acid", FakeYb(),
+                                keys=[0, 1], ops_per_key=40,
+                                group_size=3, seed=7))
+        assert t["results"]["valid?"] is True, t["results"]
+
+    def test_multi_key_acid(self):
+        t = run_clusterless(_wl("ysql/multi-key-acid", FakeYb(),
+                                keys=[0, 1], ops_per_key=30,
+                                group_size=3, seed=7))
+        assert t["results"]["valid?"] is True, t["results"]
+
+    def test_append_elle(self):
+        t = run_clusterless(_wl("ysql/append", FakeYb(), ops=100))
+        assert t["results"]["valid?"] is True, t["results"]
+
+    def test_default_value_healthy(self):
+        t = run_clusterless(_wl("ysql/default-value", FakeYb(),
+                                ops=80))
+        assert t["results"]["valid?"] is True, t["results"]
+
+    def test_default_value_detects_null_race(self):
+        t = run_clusterless(_wl("ysql/default-value",
+                                FakeYb(broken="null-default"),
+                                ops=120))
+        assert t["results"]["valid?"] is False
+
+    def test_matrix_builds(self):
+        for name in yb.WORKLOADS:
+            w, full = yb.workload_for(name, {"ops": 5})
+            assert {"generator", "checker", "client"} <= set(w), name
+            assert "/" in full
+
+    def test_bare_name_uses_api_opt(self):
+        w, full = yb.workload_for("set", {"ops": 5, "api": "ycql"})
+        assert full == "ycql/set"
+        assert w["client"].runner_factory is yb.RUNNERS["ycql"]
