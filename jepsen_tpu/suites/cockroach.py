@@ -92,6 +92,26 @@ class CockroachDB(jdb.DB):
             "process INT, tb INT)",
             f"CREATE TABLE IF NOT EXISTS {DB_NAME}.seq "
             "(key STRING PRIMARY KEY)",
+            f"CREATE TABLE IF NOT EXISTS {DB_NAME}.sets "
+            "(v INT PRIMARY KEY)",
+            f"CREATE TABLE IF NOT EXISTS {DB_NAME}.g2a "
+            "(id INT PRIMARY KEY, k INT)",
+            f"CREATE TABLE IF NOT EXISTS {DB_NAME}.g2b "
+            "(id INT PRIMARY KEY, k INT)",
+        ] + [
+            # (key, id) composite pk: the causal-reverse workload's
+            # write ids are per-key sequences, not globally unique
+            f"CREATE TABLE IF NOT EXISTS {DB_NAME}.comment_{i} "
+            "(id INT, key INT, PRIMARY KEY (key, id))"
+            for i in range(COMMENT_TABLES)
+        ] + [
+            f"CREATE TABLE IF NOT EXISTS {DB_NAME}.bank{i} "
+            "(id INT PRIMARY KEY, balance INT NOT NULL "
+            "CHECK (balance >= 0))"
+            for i in range(8)
+        ] + [
+            f"INSERT INTO {DB_NAME}.bank{i} VALUES (0, 10) "
+            "ON CONFLICT (id) DO NOTHING" for i in range(8)
         ]
         accounts = ",".join(f"({i}, 10)" for i in range(8))
         stmts.append(f"INSERT INTO {DB_NAME}.accounts VALUES "
@@ -130,6 +150,9 @@ class CockroachDB(jdb.DB):
 # ---------------------------------------------------------------------------
 # SQL transport
 # ---------------------------------------------------------------------------
+
+COMMENT_TABLES = 4
+
 
 class CrdbSql:
     """One `cockroach sql -e` batch on the client's node. Split out so
@@ -427,10 +450,185 @@ def sequential_workload(opts: dict) -> dict:
     return w
 
 
+class CrdbSetClient(jclient.Client):
+    """sets.clj: blind inserts of unique ints, one final full read."""
+
+    def __init__(self, sql_factory=CrdbSql):
+        self.sql_factory = sql_factory
+        self.sql = None
+
+    def open(self, test, node):
+        c = CrdbSetClient(self.sql_factory)
+        c.sql = self.sql_factory(test, node)
+        return c
+
+    def close(self, test):
+        if self.sql is not None:
+            self.sql.close()
+
+    def invoke(self, test, op):
+        try:
+            if op.f == "add":
+                self.sql.run(f"INSERT INTO sets (v) VALUES "
+                             f"({int(op.value)});")
+                return op.copy(type="ok")
+            out = self.sql.run("SELECT v FROM sets;")
+            return op.copy(type="ok", value=sorted(
+                int(x) for x in _data_lines(out)))
+        except RemoteError as e:
+            return _classify(op, e)
+
+
+class CrdbCommentsClient(jclient.Client):
+    """comments.clj: blind inserts of (id, key) hashed across
+    comment_N tables; reads select ids for the key across ALL tables
+    in one txn. A read seeing w but missing an acked predecessor of w
+    is the strict-serializability violation (causal-reverse)."""
+
+    def __init__(self, sql_factory=CrdbSql,
+                 table_count: int = COMMENT_TABLES):
+        self.sql_factory = sql_factory
+        self.table_count = table_count
+        self.sql = None
+
+    def open(self, test, node):
+        c = CrdbCommentsClient(self.sql_factory, self.table_count)
+        c.sql = self.sql_factory(test, node)
+        return c
+
+    def close(self, test):
+        if self.sql is not None:
+            self.sql.close()
+
+    def _table(self, wid) -> str:
+        return f"comment_{int(wid) % self.table_count}"
+
+    def invoke(self, test, op):
+        k, v = op.value
+        try:
+            if op.f == "write":
+                self.sql.run(
+                    f"INSERT INTO {self._table(v)} (id, key) VALUES "
+                    f"({int(v)}, {int(k)});")
+                return op.copy(type="ok")
+            sels = "; ".join(
+                f"SELECT id FROM comment_{i} WHERE key = {int(k)}"
+                for i in range(self.table_count))
+            out = self.sql.run(f"BEGIN; {sels}; COMMIT;")
+            ids = sorted(int(x) for x in out.split()
+                         if x.strip().lstrip("-").isdigit())
+            return op.copy(type="ok", value=(k, ids))
+        except RemoteError as e:
+            return _classify(op, e)
+
+
+class CrdbG2Client(jclient.Client):
+    """adya.clj G2: predicate-read both pair tables; insert only when
+    both are empty. Serializability allows at most one committed
+    insert per key (anti-dependency cycle otherwise)."""
+
+    def __init__(self, sql_factory=CrdbSql):
+        self.sql_factory = sql_factory
+        self.sql = None
+
+    def open(self, test, node):
+        c = CrdbG2Client(self.sql_factory)
+        c.sql = self.sql_factory(test, node)
+        return c
+
+    def close(self, test):
+        if self.sql is not None:
+            self.sql.close()
+
+    def invoke(self, test, op):
+        k, pair = op.value
+        a_id, b_id = pair
+        table, rid = (("g2a", a_id) if a_id is not None
+                      else ("g2b", b_id))
+        try:
+            # ONE statement = one serializable txn: the predicate
+            # check and the insert must not be split, or a healthy DB
+            # serializes two unconditional inserts and gets flagged
+            out = self.sql.run(
+                f"INSERT INTO {table} (id, k) "
+                f"SELECT {int(rid)}, {int(k)} WHERE NOT EXISTS "
+                f"(SELECT 1 FROM g2a WHERE k = {int(k)}) AND "
+                f"NOT EXISTS (SELECT 1 FROM g2b WHERE k = {int(k)}) "
+                "RETURNING id;")
+            if _data_lines(out):
+                return op.copy(type="ok")
+            return op.copy(type="fail", error="existing row")
+        except RemoteError as e:
+            return _classify(op, e)
+
+
+class CrdbMultiBankClient(CrdbBankClient):
+    """bank.clj multitable: each account in its own bankN table; the
+    transfer txn spans two tables (different ranges/shards)."""
+
+    def open(self, test, node):
+        c = CrdbMultiBankClient(self.sql_factory)
+        c.sql = self.sql_factory(test, node)
+        return c
+
+    def invoke(self, test, op):
+        try:
+            if op.f == "read":
+                sels = "; ".join(
+                    f"SELECT balance FROM bank{i} WHERE id = 0"
+                    for i in range(8))
+                out = self.sql.run(f"BEGIN; {sels}; COMMIT;")
+                vals = [int(x) for x in _data_lines(out)
+                        if x.strip().lstrip("-").isdigit()]
+                return op.copy(type="ok",
+                               value={i: b for i, b in
+                                      enumerate(vals)})
+            v = op.value
+            f, t, a = (int(v["from"]), int(v["to"]),
+                       int(v["amount"]))
+            self.sql.run(
+                "BEGIN; "
+                f"UPDATE bank{f} SET balance = balance - {a} "
+                "WHERE id = 0; "
+                f"UPDATE bank{t} SET balance = balance + {a} "
+                "WHERE id = 0; COMMIT;")
+            return op.copy(type="ok")
+        except RemoteError as e:
+            return _classify(op, e)
+
+
+def sets_workload(opts: dict) -> dict:
+    w = workloads.sets.workload({"ops": opts.get("ops", 400)})
+    w["client"] = CrdbSetClient()
+    return w
+
+
+def comments_workload(opts: dict) -> dict:
+    w = workloads.causal_reverse.workload(dict(opts))
+    w["client"] = CrdbCommentsClient()
+    return w
+
+
+def g2_workload(opts: dict) -> dict:
+    w = workloads.adya.workload(dict(opts))
+    w["client"] = CrdbG2Client()
+    return w
+
+
+def bank_multitable_workload(opts: dict) -> dict:
+    w = bank_workload(opts)
+    w["client"] = CrdbMultiBankClient()
+    return w
+
+
 WORKLOADS = {"register": register_workload,
              "bank": bank_workload,
+             "bank-multitable": bank_multitable_workload,
              "monotonic": monotonic_workload,
-             "sequential": sequential_workload}
+             "sequential": sequential_workload,
+             "sets": sets_workload,
+             "comments": comments_workload,
+             "g2": g2_workload}
 
 
 def cockroach_test(opts: dict) -> dict:

@@ -156,7 +156,20 @@ class DgraphHTTP:
         return resp
 
     def alter_schema(self, schema: str) -> None:
-        self._post("/alter", json.dumps({"schema": schema}))
+        # /alter takes the raw schema text as its body
+        self._post("/alter", schema, "application/dql")
+
+    def _upsert_block(self, query: str, mutations: list[tuple]) -> str:
+        """The textual upsert-block format application/rdf implies:
+        upsert { query {...} mutation @if(...) { set/delete {...} } }
+        (one mutation clause per (cond, verb, nquads) tuple)."""
+        parts = [f"upsert {{\n  query {query}\n"]
+        for cond, verb, nquads in mutations:
+            cond_s = f" {cond}" if cond else ""
+            parts.append(
+                f"  mutation{cond_s} {{ {verb} {{ {nquads} }} }}\n")
+        parts.append("}")
+        return "".join(parts)
 
     def upsert_unless_exists(self, pred: str, key, extra: dict
                              ) -> str | None:
@@ -166,11 +179,9 @@ class DgraphHTTP:
         nquads = " ".join(
             f'_:u <{p}> "{v}" .' for p, v in
             dict(extra, **{pred: key}).items())
-        body = json.dumps({
-            "query": f'{{ q(func: eq({pred}, "{key}")) '
-                     '{ v as uid } }',
-            "cond": "@if(eq(len(v), 0))",
-            "set": nquads})
+        body = self._upsert_block(
+            f'{{ q(func: eq({pred}, "{key}")) {{ v as uid }} }}',
+            [("@if(eq(len(v), 0))", "set", nquads)])
         resp = self._post("/mutate?commitNow=true", body,
                           "application/rdf")
         uids = resp.get("data", {}).get("uids") or {}
@@ -178,10 +189,9 @@ class DgraphHTTP:
 
     def delete_where(self, pred: str, key) -> int:
         """Delete every record matching pred=key (delete.clj)."""
-        body = json.dumps({
-            "query": f'{{ q(func: eq({pred}, "{key}")) '
-                     '{ v as uid } }',
-            "delete": "uid(v) * * ."})
+        body = self._upsert_block(
+            f'{{ q(func: eq({pred}, "{key}")) {{ v as uid }} }}',
+            [(None, "delete", "uid(v) * * .")])
         resp = self._post("/mutate?commitNow=true", body,
                           "application/rdf")
         return len(resp.get("data", {}).get("uids") or {})
@@ -194,13 +204,17 @@ class DgraphHTTP:
 
     def write_value(self, pred: str, key, vpred: str, value) -> None:
         """Upsert pred=key record and set vpred=value on it, in one
-        atomic upsert block (linearizable_register.clj write)."""
-        body = json.dumps({
-            "query": f'{{ q(func: eq({pred}, "{key}")) '
-                     '{ v as uid } }',
-            "set": f'uid(v) <{vpred}> "{value}" .\n'
-                   f'_:new <{pred}> "{key}" .\n'
-                   f'_:new <{vpred}> "{value}" .'})
+        atomic upsert block (linearizable_register.clj write). Two
+        conditional mutations: update-in-place when the record exists,
+        create only when it doesn't — an unconditional _:new would
+        accumulate a duplicate record on EVERY write."""
+        body = self._upsert_block(
+            f'{{ q(func: eq({pred}, "{key}")) {{ v as uid }} }}',
+            [("@if(gt(len(v), 0))", "set",
+              f'uid(v) <{vpred}> "{value}" .'),
+             ("@if(eq(len(v), 0))", "set",
+              f'_:new <{pred}> "{key}" . '
+              f'_:new <{vpred}> "{value}" .')])
         self._post("/mutate?commitNow=true", body, "application/rdf")
 
     # -- explicit transactions (startTs/commit protocol) ---------------
@@ -225,9 +239,9 @@ class DgraphHTTP:
         return resp.get("data", {}).get("q", [])
 
     def txn_set(self, txn: dict, nquads: str) -> None:
-        ts = f"&startTs={txn['start_ts']}" if txn["start_ts"] else ""
-        resp = self._post(f"/mutate?{ts.lstrip('&')}",
-                          json.dumps({"set": nquads}),
+        ts = f"?startTs={txn['start_ts']}" if txn["start_ts"] else ""
+        resp = self._post(f"/mutate{ts}",
+                          f"{{ set {{ {nquads} }} }}",
                           "application/rdf")
         self._merge_ctx(txn, resp)
 
@@ -479,17 +493,26 @@ class BankClient(_DgClient):
     def invoke(self, test, op):
         def go():
             if op.f == "read":
-                return op.copy(type="ok", value=self._balances())
+                # startTs-pinned txn: 8 per-account queries at ONE
+                # timestamp, not 8 independent snapshots
+                txn = self.http.txn_begin()
+                return op.copy(type="ok", value=self._balances(txn))
             frm, to, amt = (op.value["from"], op.value["to"],
                             op.value["amount"])
             txn = self.http.txn_begin()
             bal = self._balances(txn)
             if bal.get(frm, 0) - amt < 0:
                 return op.copy(type="fail", error="insufficient")
+            if to not in bal:
+                # destination record absent (setup raced a fault):
+                # definite no-op, not a crash
+                return op.copy(type="fail", error="no such account")
             rows_f = self.http.txn_query(txn, "acct", frm,
                                          want=("uid",))
             rows_t = self.http.txn_query(txn, "acct", to,
                                          want=("uid",))
+            if not rows_f or not rows_t:
+                return op.copy(type="fail", error="no such account")
             self.http.txn_set(
                 txn,
                 f'<{rows_f[0]["uid"]}> <amount> '
@@ -686,6 +709,9 @@ def dgraph_test(opts: dict) -> dict:
                              "perf": chk.perf(),
                              "timeline": chk.timeline()}),
         generator=_suite_generator(opts, w, pkg))
+    for extra in ("total-amount", "accounts"):
+        if extra in w:
+            test[extra] = w[extra]
     return test
 
 

@@ -23,12 +23,23 @@ bundled console jar speaking a line protocol:
     long read <name>         -> OK <v>
     long write <name> <v>    -> OK
     long cas <name> <a> <b>  -> OK | FAIL
+    ref read <name>          -> OK <v>|nil
+    ref write <name> <v>     -> OK
+    ref cas <name> <a> <b>   -> OK | FAIL
     id next <name>           -> OK <id>
     q offer <name> <v>       -> OK
     q poll <name>            -> OK <v> | EMPTY
 
-One invocation per op (`java -jar client.jar --addresses ... --cmd`),
-so crashed invocations can't leak sessions across ops.
+One JVM invocation per op (`java -jar client.jar --addresses ...
+--session jepsen-p<process> --cmd ...`): CP lock/semaphore state is
+bound to a CP SESSION, so the jar manages one NAMED session per jepsen
+process through the CP Session Management API (create-if-absent on
+first use) instead of the client's auto-session — otherwise every JVM
+exit would end the session and auto-release held locks mid-test. The
+server config stretches session-time-to-live to outlive think time
+between a process's ops; a crashed process's session simply expires
+(its locks release), exactly the reincarnation semantics the lock
+models expect.
 """
 
 from __future__ import annotations
@@ -82,7 +93,7 @@ def member_config(test) -> str:
 {members}
   cp-subsystem:
     cp-member-count: {cp}
-    session-time-to-live-seconds: 30
+    session-time-to-live-seconds: 600
     session-heartbeat-interval-seconds: 5
 """
 
@@ -131,17 +142,20 @@ class HzDB(jdb.DB):
 
 
 class HzConsole:
-    """One-shot line-protocol invocations of the bundled client jar."""
+    """One-shot line-protocol invocations of the bundled client jar,
+    bound to one named CP session per jepsen process (see module
+    docstring)."""
 
     def __init__(self, test, node, timeout: float = 10.0):
         self.node = node
         self.addresses = ",".join(f"{n}:{PORT}" for n in test["nodes"])
         self.timeout = timeout
 
-    def cmd(self, line: str) -> str:
+    def cmd(self, line: str, session: str = "jepsen") -> str:
         out = control.exec_(
             "timeout", str(int(self.timeout)), "java", "-jar",
-            CLIENT_JAR, "--addresses", self.addresses, "--cmd", line)
+            CLIENT_JAR, "--addresses", self.addresses,
+            "--session", session, "--cmd", line)
         return out.strip()
 
 
@@ -180,7 +194,9 @@ class LockClient(_HzClient):
 
     def invoke(self, test, op):
         try:
-            out = self.console.cmd(f"lock {op.f} {self.name}")
+            out = self.console.cmd(
+                f"lock {op.f} {self.name}",
+                session=f"jepsen-p{op.process}")
         except RemoteError as e:
             return op.copy(type="info", error=str(e))
         if out.startswith("OK"):
@@ -210,7 +226,9 @@ class SemaphoreClient(_HzClient):
 
     def invoke(self, test, op):
         try:
-            out = self.console.cmd(f"sem {op.f} {self.name}")
+            out = self.console.cmd(
+                f"sem {op.f} {self.name}",
+                session=f"jepsen-p{op.process}")
         except RemoteError as e:
             return op.copy(type="info", error=str(e))
         if out.startswith("OK"):
@@ -309,7 +327,13 @@ class QueueClient(_HzClient):
             if op.f == "drain":
                 got = []
                 while True:
-                    out = self.console.cmd(f"q poll {self.name}")
+                    try:
+                        out = self.console.cmd(f"q poll {self.name}")
+                    except RemoteError as e:
+                        # elements polled so far WERE dequeued; losing
+                        # them would misreport real dequeues as lost
+                        return op.copy(type="info", error=str(e),
+                                       value=got)
                     if out == "EMPTY":
                         return op.copy(type="ok", value=got)
                     if out.startswith("OK"):
@@ -384,12 +408,69 @@ def _cas_workload(opts, client):
     }
 
 
+class CasRefClient(_HzClient):
+    """read/write/cas on a CP IAtomicReference (hazelcast.clj
+    cp-cas-reference-client, 213-231): like the long, but the initial
+    value is nil and reads may return nil."""
+
+    def __init__(self, console_factory=None,
+                 name: str = "jepsen.cas-ref"):
+        super().__init__(console_factory)
+        self.name = name
+
+    def open(self, test, node):
+        c = super().open(test, node)
+        c.name = self.name
+        return c
+
+    def invoke(self, test, op):
+        try:
+            if op.f == "read":
+                out = self.console.cmd(f"ref read {self.name}")
+                if out.startswith("OK"):
+                    v = out.split()[1]
+                    return op.copy(type="ok",
+                                   value=None if v == "nil"
+                                   else int(v))
+            elif op.f == "write":
+                out = self.console.cmd(
+                    f"ref write {self.name} {op.value}")
+                if out.startswith("OK"):
+                    return op.copy(type="ok")
+            else:
+                a, b = op.value
+                out = self.console.cmd(f"ref cas {self.name} {a} {b}")
+                if out.startswith("OK"):
+                    return op.copy(type="ok")
+                if out == "FAIL":
+                    return op.copy(type="fail", error="cas failed")
+        except RemoteError as e:
+            t = "fail" if op.f == "read" else "info"
+            return op.copy(type=t, error=str(e))
+        return op.copy(type="fail", error=out)
+
+
 def cas_long(opts):
     return _cas_workload(opts, CasLongClient())
 
 
 def cas_reference(opts):
-    return _cas_workload(opts, CasLongClient(name="jepsen.cas-ref"))
+    """IAtomicReference starts at nil, so the model's initial value
+    differs from cas_long's 0."""
+    import random as _random
+
+    o = dict(opts)
+    rng = _random.Random(o.get("seed"))
+    from ..workloads import register as register_wl
+
+    g = gen.limit(o.get("ops", 300),
+                  lambda: register_wl.cas_op_mix(rng))
+    return {
+        "generator": g,
+        "checker": chk.linearizable(
+            {"model": models.cas_register(None)}),
+        "client": CasRefClient(),
+    }
 
 
 def id_gen(opts):

@@ -27,6 +27,7 @@ clusterless tests substitute scripted fakes.
 from __future__ import annotations
 
 import logging
+import re
 import random as _random
 
 from .. import checker as chk
@@ -79,6 +80,12 @@ class YbDB(jdb.DB):
         self._start_master(test, node)
         self._start_tserver(test, node)
         cu.await_tcp_port(YSQL_PORT, timeout_secs=180)
+        # YCQL clients run inside this keyspace (ycqlsh has no
+        # default; unqualified DDL would fail otherwise)
+        control.exec_(
+            f"{DIR}/bin/ycqlsh", node, str(YCQL_PORT), "-e",
+            f"CREATE KEYSPACE IF NOT EXISTS {KEYSPACE};",
+            check=False)
 
     def _start_master(self, test, node):
         with control.su():
@@ -162,7 +169,8 @@ class YcqlRunner:
     def run(self, stmt: str) -> str:
         return control.exec_(
             f"{DIR}/bin/ycqlsh", self.node, str(YCQL_PORT),
-            "--no-color", "-e", stmt, timeout=self.timeout)
+            "--no-color", "-k", KEYSPACE, "-e", stmt,
+            timeout=self.timeout)
 
     def close(self):
         pass
@@ -184,9 +192,24 @@ def _classify(op, e: Exception, writing: bool):
                    error=str(e)[:200])
 
 
+def _int_lines(out: str) -> list[int]:
+    """Integers from CLI output, one per line — robust to ycqlsh's
+    headers, rules, and '(n rows)' trailers."""
+    vals = []
+    for line in out.splitlines():
+        s = line.strip()
+        if re.fullmatch(r"-?\d+", s):
+            vals.append(int(s))
+    return vals
+
+
 class _YbClient(jclient.Client):
     runner_factory: type = YsqlRunner
     setup_stmts: tuple = ()
+
+    @property
+    def dialect(self) -> str:
+        return getattr(self.runner, "dialect", "ysql")
 
     def __init__(self, runner_factory=None):
         if runner_factory is not None:
@@ -218,14 +241,22 @@ class _YbClient(jclient.Client):
 
 class CounterClient(_YbClient):
     """increment/read one counter row (ycql/counter.clj uses a CQL
-    counter column; ysql an int column)."""
+    counter column; ysql an int column). UPDATE .. count + x is valid
+    in both dialects; only the DDL differs."""
 
-    setup_stmts = (
-        "CREATE TABLE IF NOT EXISTS counters (id INT PRIMARY KEY, "
-        "count INT)",
-        "INSERT INTO counters (id, count) VALUES (0, 0) "
-        "ON CONFLICT (id) DO NOTHING",
-    )
+    @property
+    def setup_stmts(self):
+        if self.dialect == "ycql":
+            # CQL counter tables can't be INSERTed; the first UPDATE
+            # creates the row
+            return ("CREATE TABLE IF NOT EXISTS counters "
+                    "(id INT PRIMARY KEY, count COUNTER)",)
+        return (
+            "CREATE TABLE IF NOT EXISTS counters (id INT PRIMARY "
+            "KEY, count INT)",
+            "INSERT INTO counters (id, count) VALUES (0, 0) "
+            "ON CONFLICT (id) DO NOTHING",
+        )
 
     def invoke(self, test, op):
         try:
@@ -235,7 +266,8 @@ class CounterClient(_YbClient):
                 return op.copy(type="ok")
             out = self.runner.run(
                 "SELECT count FROM counters WHERE id = 0")
-            return op.copy(type="ok", value=int(out.strip() or 0))
+            vals = _int_lines(out)
+            return op.copy(type="ok", value=vals[0] if vals else 0)
         except RemoteError as e:
             return _classify(op, e, op.f == "add")
 
@@ -260,8 +292,7 @@ class SetClient(_YbClient):
                     f"INSERT INTO elements (v) VALUES ({op.value})")
                 return op.copy(type="ok")
             out = self.runner.run("SELECT v FROM elements")
-            vals = sorted(int(x) for x in out.split() if x.strip())
-            return op.copy(type="ok", value=vals)
+            return op.copy(type="ok", value=sorted(_int_lines(out)))
         except RemoteError as e:
             return _classify(op, e, op.f == "add")
 
@@ -289,22 +320,23 @@ class BankClient(_YbClient):
 
     @property
     def setup_stmts(self):
+        # CQL INSERT is already an upsert; ON CONFLICT is ysql-only
+        guard = ("" if self.dialect == "ycql"
+                 else " ON CONFLICT (id) DO NOTHING")
         if self.multitable:
             out = []
             for a in self.accounts:
                 out.append(f"CREATE TABLE IF NOT EXISTS bank{a} "
                            "(id INT PRIMARY KEY, balance INT)")
                 out.append(f"INSERT INTO bank{a} (id, balance) "
-                           f"VALUES (0, {self.initial}) "
-                           "ON CONFLICT (id) DO NOTHING")
+                           f"VALUES (0, {self.initial}){guard}")
             return tuple(out)
         return (
             "CREATE TABLE IF NOT EXISTS bank (id INT PRIMARY KEY, "
             "balance INT)",
         ) + tuple(
             f"INSERT INTO bank (id, balance) VALUES ({a}, "
-            f"{self.initial}) ON CONFLICT (id) DO NOTHING"
-            for a in self.accounts)
+            f"{self.initial}){guard}" for a in self.accounts)
 
     def _table(self, a):
         return f"bank{a}" if self.multitable else "bank"
@@ -312,25 +344,45 @@ class BankClient(_YbClient):
     def _id(self, a):
         return 0 if self.multitable else a
 
+    def _read_stmt(self) -> str:
+        # ONE statement = one snapshot: a per-account SELECT loop
+        # would read across concurrent transfers (bank.clj reads all
+        # balances in a single query)
+        if self.multitable:
+            return " UNION ALL ".join(
+                f"SELECT {a} AS id, balance FROM bank{a} WHERE id = 0"
+                for a in self.accounts)
+        if self.dialect == "ycql":
+            # CQL rejects ORDER BY on the partition key; rows sort
+            # host-side by the parsed ids anyway
+            return "SELECT id, balance FROM bank"
+        return "SELECT id, balance FROM bank ORDER BY id"
+
+    def _txn(self, stmts: list[str]) -> str:
+        if self.dialect == "ycql":
+            return ("BEGIN TRANSACTION " + "; ".join(stmts)
+                    + "; END TRANSACTION;")
+        return ("BEGIN TRANSACTION ISOLATION LEVEL SERIALIZABLE; "
+                + "; ".join(stmts) + "; COMMIT;")
+
     def invoke(self, test, op):
         try:
             if op.f == "read":
+                out = self.runner.run(self._read_stmt())
                 bal = {}
-                for a in self.accounts:
-                    out = self.runner.run(
-                        f"SELECT balance FROM {self._table(a)} "
-                        f"WHERE id = {self._id(a)}")
-                    if out.strip():
-                        bal[a] = int(out.strip())
+                for line in out.splitlines():
+                    m = re.match(r"\s*(\d+)\s*\|\s*(-?\d+)\s*$",
+                                 line)
+                    if m:
+                        bal[int(m.group(1))] = int(m.group(2))
                 return op.copy(type="ok", value=bal)
             v = op.value
             frm, to, amt = v["from"], v["to"], v["amount"]
-            self.runner.run(
-                "BEGIN TRANSACTION ISOLATION LEVEL SERIALIZABLE; "
+            self.runner.run(self._txn([
                 f"UPDATE {self._table(frm)} SET balance = balance - "
-                f"{amt} WHERE id = {self._id(frm)}; "
+                f"{amt} WHERE id = {self._id(frm)}",
                 f"UPDATE {self._table(to)} SET balance = balance + "
-                f"{amt} WHERE id = {self._id(to)}; COMMIT;")
+                f"{amt} WHERE id = {self._id(to)}"]))
             return op.copy(type="ok")
         except RemoteError as e:
             return _classify(op, e, op.f == "transfer")
@@ -359,20 +411,33 @@ class SingleKeyAcidClient(_YbClient):
             if op.f == "read":
                 out = self.runner.run(
                     f"SELECT val FROM registers WHERE id = {k}")
-                return op.copy(
-                    type="ok",
-                    value=(k, int(out.strip()) if out.strip()
-                           else None))
+                vals = _int_lines(out)
+                return op.copy(type="ok",
+                               value=(k, vals[0] if vals else None))
             if op.f == "write":
-                self.runner.run(
-                    f"INSERT INTO registers (id, val) VALUES ({k}, "
-                    f"{v}) ON CONFLICT (id) DO UPDATE SET val = {v}")
+                if self.dialect == "ycql":
+                    # CQL INSERT is an upsert
+                    self.runner.run(
+                        f"INSERT INTO registers (id, val) VALUES "
+                        f"({k}, {v})")
+                else:
+                    self.runner.run(
+                        f"INSERT INTO registers (id, val) VALUES "
+                        f"({k}, {v}) ON CONFLICT (id) DO UPDATE SET "
+                        f"val = {v}")
                 return op.copy(type="ok")
             old, new = v
-            out = self.runner.run(
-                f"UPDATE registers SET val = {new} WHERE id = {k} "
-                f"AND val = {old} RETURNING val")
-            if out.strip():
+            if self.dialect == "ycql":
+                out = self.runner.run(
+                    f"UPDATE registers SET val = {new} WHERE "
+                    f"id = {k} IF val = {old}")
+                applied = "true" in out.lower()
+            else:
+                out = self.runner.run(
+                    f"UPDATE registers SET val = {new} WHERE "
+                    f"id = {k} AND val = {old} RETURNING val")
+                applied = bool(_int_lines(out))
+            if applied:
                 return op.copy(type="ok")
             return op.copy(type="fail", error="cas mismatch")
         except RemoteError as e:
@@ -426,20 +491,33 @@ class MultiKeyAcidClient(_YbClient):
         k, v = op.value
         try:
             if op.f == "write":
-                stmts = "; ".join(
-                    f"INSERT INTO multireg (id, val) VALUES "
-                    f"('{k}_{sk}', {x}) ON CONFLICT (id) DO UPDATE "
-                    f"SET val = {x}" for sk, x in v)
-                self.runner.run(
-                    "BEGIN TRANSACTION ISOLATION LEVEL SERIALIZABLE; "
-                    + stmts + "; COMMIT;")
+                if self.dialect == "ycql":
+                    stmts = "; ".join(
+                        f"INSERT INTO multireg (id, val) VALUES "
+                        f"('{k}_{sk}', {x})" for sk, x in v)
+                    self.runner.run("BEGIN TRANSACTION " + stmts
+                                    + "; END TRANSACTION;")
+                else:
+                    stmts = "; ".join(
+                        f"INSERT INTO multireg (id, val) VALUES "
+                        f"('{k}_{sk}', {x}) ON CONFLICT (id) DO "
+                        f"UPDATE SET val = {x}" for sk, x in v)
+                    self.runner.run(
+                        "BEGIN TRANSACTION ISOLATION LEVEL "
+                        "SERIALIZABLE; " + stmts + "; COMMIT;")
                 return op.copy(type="ok")
-            got = []
-            for sk, _x in v:
-                out = self.runner.run(
-                    f"SELECT val FROM multireg WHERE id = '{k}_{sk}'")
-                got.append([sk, int(out.strip()) if out.strip()
-                            else None])
+            # ONE statement = one snapshot; a per-subkey SELECT loop
+            # could observe an atomic write half-applied
+            ids = ", ".join(f"'{k}_{sk}'" for sk, _x in v)
+            out = self.runner.run(
+                f"SELECT id, val FROM multireg WHERE id IN ({ids})")
+            seen = {}
+            for line in out.splitlines():
+                m = re.match(
+                    r"\s*(\S+?)_(\d+)\s*\|\s*(-?\d+)\s*$", line)
+                if m:
+                    seen[int(m.group(2))] = int(m.group(3))
+            got = [[sk, seen.get(sk)] for sk, _x in v]
             return op.copy(type="ok", value=(k, got))
         except RemoteError as e:
             return _classify(op, e, op.f == "write")
@@ -471,7 +549,6 @@ class AppendClient(_YbClient):
     def invoke(self, test, op):
         try:
             stmts = []
-            reads = []
             for i, (f, k, v) in enumerate(op.value):
                 if f == "append":
                     stmts.append(
@@ -479,19 +556,26 @@ class AppendClient(_YbClient):
                         f"({k}, '{v}') ON CONFLICT (k) DO UPDATE SET "
                         f"v = {self._table(k)}.v || ',{v}'")
                 else:
-                    reads.append(i)
+                    # tagged scalar subquery: ALWAYS one output line,
+                    # so zero-row reads can't shift later reads'
+                    # positional alignment
                     stmts.append(
-                        f"SELECT v FROM {self._table(k)} WHERE "
-                        f"k = {k}")
+                        f"SELECT 'm{i}=' || COALESCE((SELECT v FROM "
+                        f"{self._table(k)} WHERE k = {k}), '~')")
             out = self.runner.run(
                 "BEGIN TRANSACTION ISOLATION LEVEL SERIALIZABLE; "
                 + "; ".join(stmts) + "; COMMIT;")
-            lines = [ln for ln in out.splitlines()]
-            res = [list(m) for m in op.value]
-            for j, i in enumerate(reads):
-                raw = lines[j].strip() if j < len(lines) else ""
-                res[i][2] = ([int(x) for x in raw.split(",")]
-                             if raw else [])
+            tagged = {}
+            for line in out.splitlines():
+                m = re.match(r"\s*m(\d+)=(.*)$", line.strip())
+                if m:
+                    tagged[int(m.group(1))] = m.group(2)
+            res = [list(m_) for m_ in op.value]
+            for i, (f, k, v) in enumerate(op.value):
+                if f != "append":
+                    raw = tagged.get(i, "~")
+                    res[i][2] = ([int(x) for x in raw.split(",") if x]
+                                 if raw != "~" else [])
             return op.copy(type="ok", value=res)
         except RemoteError as e:
             return _classify(op, e, True)
@@ -499,6 +583,80 @@ class AppendClient(_YbClient):
 
 class AppendTableClient(AppendClient):
     per_table = True
+
+
+class TxnWClient(_YbClient):
+    """w/r micro-op txns for long-fork (ycql/ysql long_fork.clj):
+    writes upsert single-int cells, reads come back tagged so
+    zero-row reads can't misalign."""
+
+    setup_stmts = (
+        "CREATE TABLE IF NOT EXISTS lf (k INT PRIMARY KEY, v INT)",
+    )
+
+    def _invoke_ycql(self, op):
+        # YCQL transactions accept only DML — no SELECT, no
+        # expressions. long-fork txns are single-write or all-read
+        # (long_fork.clj's generator shape), so: writes go in a
+        # DML-only txn, reads as ONE SELECT .. IN (a single-statement
+        # snapshot).
+        writes = [(k, v) for f, k, v in op.value if f == "w"]
+        res = [list(m_) for m_ in op.value]
+        if writes:
+            stmts = "; ".join(f"INSERT INTO lf (k, v) VALUES "
+                              f"({k}, {v})" for k, v in writes)
+            if len(writes) == 1:
+                self.runner.run(stmts + ";")
+            else:
+                self.runner.run("BEGIN TRANSACTION " + stmts
+                                + "; END TRANSACTION;")
+        read_keys = [k for f, k, v in op.value if f == "r"]
+        if read_keys:
+            ks = ", ".join(str(k) for k in read_keys)
+            out = self.runner.run(
+                f"SELECT k, v FROM lf WHERE k IN ({ks})")
+            seen = {}
+            for line in out.splitlines():
+                m = re.match(r"\s*(\d+)\s*\|\s*(-?\d+)\s*$",
+                             line)
+                if m:
+                    seen[int(m.group(1))] = int(m.group(2))
+            for i, (f, k, v) in enumerate(op.value):
+                if f == "r":
+                    res[i][2] = seen.get(k)
+        return op.copy(type="ok", value=res)
+
+    def invoke(self, test, op):
+        try:
+            if self.dialect == "ycql":
+                return self._invoke_ycql(op)
+            stmts = []
+            for i, (f, k, v) in enumerate(op.value):
+                if f == "w":
+                    stmts.append(
+                        f"INSERT INTO lf (k, v) VALUES ({k}, {v})"
+                        f" ON CONFLICT (k) DO UPDATE SET v = {v}")
+                else:
+                    stmts.append(
+                        f"SELECT 'm{i}=' || COALESCE((SELECT "
+                        f"CAST(v AS TEXT) FROM lf WHERE k = {k}), "
+                        "'~')")
+            out = self.runner.run(
+                "BEGIN TRANSACTION ISOLATION LEVEL SERIALIZABLE; "
+                + "; ".join(stmts) + "; COMMIT;")
+            tagged = {}
+            for line in out.splitlines():
+                m = re.match(r"\s*m(\d+)=(.*)$", line.strip())
+                if m:
+                    tagged[int(m.group(1))] = m.group(2)
+            res = [list(m_) for m_ in op.value]
+            for i, (f, k, v) in enumerate(op.value):
+                if f == "r":
+                    raw = tagged.get(i, "~")
+                    res[i][2] = None if raw == "~" else int(raw)
+            return op.copy(type="ok", value=res)
+        except RemoteError as e:
+            return _classify(op, e, True)
 
 
 # -- default-value (DDL race) ----------------------------------------------
@@ -623,14 +781,14 @@ WORKLOADS = {
     "ycql/set": _with(sets_wl.workload, SetClient),
     "ycql/set-index": _with(sets_wl.workload, SetIndexClient),
     "ycql/bank": _with(_bank, BankClient),
-    "ycql/long-fork": _with(lf_wl.workload, AppendClient),
+    "ycql/long-fork": _with(lf_wl.workload, TxnWClient),
     "ycql/single-key-acid": single_key_acid_workload,
     "ycql/multi-key-acid": multi_key_acid_workload,
     "ysql/counter": _with(counter_wl.workload, CounterClient),
     "ysql/set": _with(sets_wl.workload, SetClient),
     "ysql/bank": _with(_bank, BankClient),
     "ysql/bank-multitable": _with(_bank, MultiBankClient),
-    "ysql/long-fork": _with(lf_wl.workload, AppendClient),
+    "ysql/long-fork": _with(lf_wl.workload, TxnWClient),
     "ysql/single-key-acid": single_key_acid_workload,
     "ysql/multi-key-acid": multi_key_acid_workload,
     "ysql/append": _with(append_wl.workload, AppendClient),

@@ -263,3 +263,163 @@ class TestCli:
                 "time_limit": 5}
         test = crdb.cockroach_test(opts)
         assert test["name"] == "cockroach-monotonic"
+
+
+class FakeCrdbFull(FakeCrdb):
+    """FakeCrdb extended with the sets / comments / g2 / multitable
+    bank statement shapes. broken='causal-reverse' delays write
+    visibility: an insert lands only after a LATER insert to the same
+    key arrives (T2 visible without T1); broken='g2-race' skips the
+    predicate-read guard every other insert."""
+
+    def __init__(self, broken=None):
+        super().__init__()
+        self.broken = broken
+        self.sets: list = []
+        self.comments: dict = {}   # table -> {id: key}
+        self.held: dict = {}       # key -> held-back (table, id)
+        self.g2: dict = {"g2a": {}, "g2b": {}}
+        self.g2_calls = 0
+        self.banks = {i: 10 for i in range(8)}
+
+    def run(self, sql: str) -> str:
+        with self.lock:
+            out = self._full(sql)
+        if out is not None:
+            return out
+        return super().run(sql)
+
+    def _full(self, sql: str):
+        if sql.startswith("INSERT INTO sets"):
+            self.sets.append(int(re.search(r"\((\d+)\)", sql)
+                                 .group(1)))
+            return ""
+        if sql.startswith("SELECT v FROM sets"):
+            return "v\n" + "\n".join(map(str, self.sets))
+        m = re.match(r"INSERT INTO (comment_\d+) \(id, key\) VALUES "
+                     r"\((\d+), (\d+)\);", sql)
+        if m:
+            t, i, k = m.group(1), int(m.group(2)), int(m.group(3))
+            if self.broken == "causal-reverse" and k not in self.held:
+                # FIRST write acks but stays invisible while LATER
+                # writes land visibly -> T2 visible without T1
+                self.held[k] = [t, i, 0]
+            else:
+                self.comments.setdefault(t, {})[(k, i)] = k
+                if k in self.held:
+                    h = self.held[k]
+                    h[2] += 1
+                    if h[2] >= 3:  # finally becomes visible
+                        self.comments.setdefault(
+                            h[0], {})[(k, h[1])] = k
+                        del self.held[k]
+            return ""
+        if "FROM comment_0" in sql:
+            k = int(re.search(r"key = (\d+)", sql).group(1))
+            ids = [str(i) for t, rows in sorted(
+                       self.comments.items())
+                   for (kk, i) in sorted(rows) if kk == k]
+            return "id\n" + "\n".join(ids)
+        m = re.search(r"INSERT INTO (g2a|g2b) \(id, k\) SELECT "
+                      r"(\d+), (\d+) WHERE NOT EXISTS", sql)
+        if m:
+            t, i, k = m.group(1), int(m.group(2)), int(m.group(3))
+            na = sum(1 for v in self.g2["g2a"].values() if v == k)
+            nb = sum(1 for v in self.g2["g2b"].values() if v == k)
+            # 'g2-race': the predicate read inside the txn is blind to
+            # concurrent commits (the G2 anomaly itself)
+            if (na or nb) and self.broken != "g2-race":
+                return "id"  # guard saw a row: zero rows inserted
+            self.g2[t][i] = k
+            return f"id\n{i}"
+        if re.search(r"SELECT balance FROM bank0", sql):
+            return "balance\n" + "\n".join(
+                str(self.banks[i]) for i in range(8))
+        m = re.search(r"UPDATE bank(\d+) SET balance = balance - "
+                      r"(\d+).*UPDATE bank(\d+) SET balance = "
+                      r"balance \+ (\d+)", sql, re.S)
+        if m:
+            f, a = int(m.group(1)), int(m.group(2))
+            t = int(m.group(3))
+            if self.banks[f] - a < 0:
+                raise _CrdbError("violates check constraint "
+                                 "balance >= 0")
+            self.banks[f] -= a
+            self.banks[t] += a
+            return ""
+        return None
+
+
+class _CrdbError(Exception):
+    pass
+
+
+class FakeFullFactory(FakeSqlFactory):
+    def __init__(self, state=None, broken=None):
+        self.state = state or FakeCrdbFull(broken)
+
+    def __call__(self, test, node, timeout=10.0):
+        factory = self
+
+        class _S:
+            def run(self, sql):
+                try:
+                    return factory.state.run(sql)
+                except _CrdbError as e:
+                    from jepsen_tpu.control.core import RemoteError
+
+                    raise RemoteError("sql failed", exit=1, out="",
+                                      err=str(e), cmd="sql",
+                                      node=node)
+
+            def close(self):
+                pass
+
+        return _S()
+
+
+class TestNewWorkloads:
+    def test_sets(self):
+        t = run_workload(crdb.sets_workload, {"ops": 100,
+                                            "gen_ops": 130},
+                         FakeFullFactory())
+        assert t["results"]["valid?"] is True, t["results"]
+
+    def test_comments_healthy(self):
+        t = run_workload(crdb.comments_workload,
+                         {"keys": [0, 1], "per-key-limit": 40,
+                          "gen_ops": 100},
+                         FakeFullFactory())
+        assert t["results"]["valid?"] is True, t["results"]
+
+    def test_comments_detects_causal_reverse(self):
+        t = run_workload(crdb.comments_workload,
+                         {"keys": [0], "per-key-limit": 80,
+                          "group-size": 3, "gen_ops": 120,
+                          "concurrency": 6},
+                         FakeFullFactory(broken="causal-reverse"))
+        assert t["results"]["valid?"] is False
+
+    def test_g2_healthy_and_racy(self):
+        t = run_workload(crdb.g2_workload,
+                         {"keys": list(range(1, 13)),
+                          "gen_ops": 60, "concurrency": 6},
+                         FakeFullFactory())
+        assert t["results"]["valid?"] is True, t["results"]
+        t = run_workload(crdb.g2_workload,
+                         {"keys": list(range(1, 13)),
+                          "gen_ops": 60, "concurrency": 6},
+                         FakeFullFactory(broken="g2-race"))
+        assert t["results"]["valid?"] is False
+
+    def test_bank_multitable(self):
+        t = run_workload(crdb.bank_multitable_workload,
+                         {"ops": 80, "gen_ops": 100},
+                         FakeFullFactory())
+        assert t["results"]["valid?"] is True, t["results"]
+
+    def test_menu_matches_reference(self):
+        # cockroach.clj test menu
+        assert set(crdb.WORKLOADS) == {
+            "register", "bank", "bank-multitable", "monotonic",
+            "sequential", "sets", "comments", "g2"}

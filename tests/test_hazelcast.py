@@ -67,6 +67,7 @@ class FakeHz:
         self.fences = {}     # name -> next fence
         self.sems = {}       # name -> {owner: count}
         self.longs = {}      # name -> int
+        self.refs = {}       # name -> int | None (nil)
         self.ids = {}        # name -> int
         self.queues = {}     # name -> list
         self.attempts = 0
@@ -83,6 +84,8 @@ class FakeHz:
             return self._sem(who, parts[1], parts[2])
         if kind == "long":
             return self._long(parts[1:])
+        if kind == "ref":
+            return self._ref(parts[1:])
         if kind == "id":
             n = self.ids.get(parts[2], 0)
             self.ids[parts[2]] = n + 1
@@ -134,6 +137,21 @@ class FakeHz:
             return "OK"
         return "ERR not-permit-owner"
 
+    def _ref(self, parts):
+        # IAtomicReference: initial nil, CAS against nil works
+        f, name = parts[0], parts[1]
+        v = self.refs.get(name)
+        if f == "read":
+            return f"OK {'nil' if v is None else v}"
+        if f == "write":
+            self.refs[name] = int(parts[2])
+            return "OK"
+        a, b = int(parts[2]), int(parts[3])
+        if v == a:
+            self.refs[name] = b
+            return "OK"
+        return "FAIL"
+
     def _long(self, parts):
         f, name = parts[0], parts[1]
         v = self.longs.get(name, 0)
@@ -150,8 +168,9 @@ class FakeHz:
 
 
 class FakeConsoleFactory:
-    """console_factory plug for the suite's clients: each opened
-    console is a distinct CP session (keyed by node+instance)."""
+    """console_factory plug for the suite's clients: sessions are the
+    per-process names the clients pass (the jar's named-CP-session
+    model), falling back to a per-console identity."""
 
     def __init__(self, state=None):
         self.state = state or FakeHz()
@@ -159,11 +178,11 @@ class FakeConsoleFactory:
 
     def __call__(self, test, node, timeout=10.0):
         self._n += 1
-        factory, session = self, f"{node}#{self._n}"
+        factory, default = self, f"{node}#{self._n}"
 
         class _Console:
-            def cmd(self, line):
-                return factory.state.cmd(session, line)
+            def cmd(self, line, session=None):
+                return factory.state.cmd(session or default, line)
 
         return _Console()
 
@@ -206,6 +225,29 @@ class TestWorkloadsEndToEnd:
     def test_cas_long(self):
         t = run_clusterless(self._wl("cas-long", FakeHz(), ops=50))
         assert t["results"]["valid?"] is True, t["results"]
+
+    def test_cas_reference_nil_initial(self):
+        t = run_clusterless(self._wl("cas-reference", FakeHz(),
+                                     ops=50))
+        assert t["results"]["valid?"] is True, t["results"]
+        # non-vacuous: values were really read back
+        reads = [o.value for o in t["history"]
+                 if o.type == "ok" and o.f == "read"]
+        assert any(v is not None for v in reads)
+
+    def test_cas_reference_protocol_nil(self):
+        fac = FakeConsoleFactory(FakeHz())
+        c = hz.CasRefClient(console_factory=fac).open(
+            {"nodes": ["n1"]}, "n1")
+        r = c.invoke({}, Op(type="invoke", process=0, f="read",
+                            value=None))
+        assert r.type == "ok" and r.value is None
+        assert c.invoke({}, Op(type="invoke", process=0, f="cas",
+                               value=[0, 3])).type == "fail"
+        assert c.invoke({}, Op(type="invoke", process=0, f="write",
+                               value=2)).type == "ok"
+        assert c.invoke({}, Op(type="invoke", process=0, f="read",
+                               value=None)).value == 2
 
     def test_id_gen_unique(self):
         t = run_clusterless(self._wl("id-gen", FakeHz(), ops=50))

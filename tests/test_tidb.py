@@ -126,13 +126,17 @@ class FakeSqlFactory:
 def run_workload(workload_fn, opts, factory, extra_test=None):
     w = workload_fn(opts)
     w["client"].sql_factory = factory
+    main = gen.clients(
+        gen.stagger(0.0004, gen.limit(
+            opts.get("gen_ops", 250), w["generator"])))
+    final = w.get("final_generator")
+    g = main if final is None else gen.phases(
+        main, gen.clients(final))
     test = testing.noop_test()
     test.update(nodes=["n1", "n2"],
                 concurrency=opts.get("concurrency", 6),
                 client=w["client"], checker=w["checker"],
-                generator=gen.clients(
-                    gen.stagger(0.0004, gen.limit(
-                        opts.get("gen_ops", 250), w["generator"]))))
+                generator=g)
     if w.get("lf-table"):
         test["lf-table"] = True
     test.update(extra_test or {})
@@ -225,7 +229,8 @@ class TestCli:
         test = td.tidb_test(opts)
         assert test["name"] == "tidb-append"
         tests = list(td.all_tests(opts))
-        assert len(tests) == 3 * 3  # workloads x fault options
+        # every workload x the three fault options
+        assert len(tests) == len(td.WORKLOADS) * 3
         lf = td.tidb_test({**opts, "workload": "long-fork"})
         assert lf["lf-table"] is True
 
@@ -237,3 +242,231 @@ class TestCli:
         # the composed package nemesis, not the bare partitioner
         bare = td.tidb_test({**opts, "faults": None})
         assert type(test["nemesis"]) is not type(bare["nemesis"])
+
+
+class FakeTidbFull(FakeTidb):
+    """FakeTidb extended with the round-5 workload statement shapes:
+    registers, sets (plain + CAS blob), sequential subkeys, monotonic
+    rows, and DDL'd tN tables. broken='mono-reorder' hands out
+    timestamps that run backwards; broken='ghost-table' acks
+    create-table but doesn't create every 3rd table."""
+
+    def __init__(self, broken=None):
+        super().__init__()
+        self.broken = broken
+        self.banks = {i: 10 for i in range(8)}
+        self.registers: dict = {}
+        self.sets: list = []
+        self.setcas = ""
+        self.seq: set = set()
+        self.mono: list = []
+        self.ts = 100
+        self.created: set = set()
+        self.creates = 0
+        self.vars: dict = {}
+
+    def _stmt(self, s):
+        m = re.match(r"SELECT CONCAT\('v=', COALESCE\(\(SELECT val "
+                     r"FROM registers WHERE id = (\d+)\), '~'\)\)", s)
+        if m:
+            v = self.registers.get(int(m.group(1)))
+            return "v=" + ("~" if v is None else str(v))
+        m = re.match(r"INSERT INTO registers \(id, val\) VALUES "
+                     r"\((\d+), (\d+)\) ON DUPLICATE KEY", s)
+        if m:
+            self.registers[int(m.group(1))] = int(m.group(2))
+            return None
+        m = re.match(r"UPDATE registers SET val = (\d+) WHERE "
+                     r"id = (\d+) AND val = (\d+)", s)
+        if m:
+            new, k, old = (int(m.group(1)), int(m.group(2)),
+                           int(m.group(3)))
+            hit = self.registers.get(k) == old
+            if hit:
+                self.registers[k] = new
+            self.vars["rowcount"] = 1 if hit else 0
+            return None
+        if re.match(r"SELECT CONCAT\('n=', ROW_COUNT\(\)\)", s):
+            return f"n={self.vars.get('rowcount', 0)}"
+        m = re.match(r"INSERT INTO sets \(val\) VALUES \((\d+)\)", s)
+        if m:
+            self.sets.append(int(m.group(1)))
+            return None
+        if s == "SELECT val FROM sets":
+            return "\n".join(str(x) for x in self.sets)
+        m = re.match(r"SELECT val INTO @v FROM setcas", s)
+        if m:
+            self.vars["v"] = self.setcas
+            return None
+        m = re.match(r"UPDATE setcas SET val = CONCAT\(@v, ',', "
+                     r"'(\d+)'\)", s)
+        if m:
+            self.setcas = f"{self.vars['v']},{m.group(1)}"
+            return None
+        m = re.match(r"SELECT CONCAT\('s=', val\) FROM setcas", s)
+        if m:
+            return f"s={self.setcas}"
+        m = re.match(r"INSERT IGNORE INTO seq \(sk\) VALUES "
+                     r"'?\('([\w]+)'\)", s)
+        if m:
+            self.seq.add(m.group(1))
+            return None
+        m = re.match(r"SELECT CONCAT\('x=', COUNT\(\*\)\) FROM seq "
+                     r"WHERE sk = '([\w]+)'", s)
+        if m:
+            return f"x={1 if m.group(1) in self.seq else 0}"
+        if re.match(r"SELECT COALESCE\(MAX\(val\), 0\) \+ 1, "
+                    r"@@tidb_current_ts INTO @v, @ts FROM mono", s):
+            mx = max((r["val"] for r in self.mono), default=0)
+            self.vars["v"] = mx + 1
+            self.ts += 1
+            ts = self.ts
+            if self.broken == "mono-reorder" and mx % 5 == 4:
+                ts -= 3  # commit timestamp runs backwards
+            self.vars["ts"] = ts
+            return None
+        m = re.match(r"INSERT INTO mono \(val, sts, node, process, "
+                     r"tb\) VALUES \(@v, @ts, '([\w.-]+)', (\d+), "
+                     r"(\d+)\)", s)
+        if m:
+            self.mono.append({"val": self.vars["v"],
+                              "sts": self.vars["ts"],
+                              "node": m.group(1),
+                              "process": int(m.group(2)),
+                              "tb": int(m.group(3))})
+            return None
+        if re.match(r"SELECT CONCAT\('row=', @v, ':', @ts\)", s):
+            return f"row={self.vars['v']}:{self.vars['ts']}"
+        if s.startswith("SELECT CONCAT('r=', val"):
+            rows = sorted(self.mono,
+                          key=lambda r: (r["sts"], r["val"]))
+            return "\n".join(
+                f"r={r['val']}:{r['sts']}:{r['node']}:"
+                f"{r['process']}:{r['tb']}" for r in rows)
+        m = re.match(r"SELECT balance INTO @b1 FROM bank(\d+) "
+                     r"WHERE id = 0 FOR UPDATE", s)
+        if m:
+            self.vars["b1"] = self.banks[int(m.group(1))]
+            return None
+        m = re.match(r"UPDATE bank(\d+) SET balance = balance "
+                     r"([+-]) (\d+) WHERE id = 0 AND @b1 >= (\d+)",
+                     s)
+        if m:
+            if self.vars.get("b1", 0) >= int(m.group(4)):
+                d = int(m.group(3))
+                i = int(m.group(1))
+                self.banks[i] += d if m.group(2) == "+" else -d
+            return None
+        m = re.match(r"SELECT CONCAT\('applied=', IF\(@b1 >= "
+                     r"(\d+), 1, 0\)\)", s)
+        if m:
+            ok = 1 if self.vars.get("b1", 0) >= int(m.group(1)) else 0
+            return f"applied={ok}"
+        if s.startswith("SELECT CONCAT('b=', GROUP_CONCAT"):
+            return "b=" + ",".join(
+                f"{i}:{b}" for i, b in sorted(self.banks.items()))
+        m = re.match(r"CREATE TABLE IF NOT EXISTS t(\d+) ", s)
+        if m:
+            self.creates += 1
+            if not (self.broken == "ghost-table"
+                    and self.creates % 3 == 0):
+                self.created.add(int(m.group(1)))
+            return None
+        m = re.match(r"INSERT INTO t(\d+) \(id\) VALUES \((\d+)\)", s)
+        if m:
+            t = int(m.group(1))
+            if t not in self.created:
+                raise _FakeSqlError(f"Table 'jepsen.t{t}' "
+                                    "doesn't exist")
+            return None
+        return super()._stmt(s)
+
+
+class _FakeSqlError(Exception):
+    pass
+
+
+class FakeFullFactory(FakeSqlFactory):
+    def __init__(self, state=None, broken=None):
+        self.state = state or FakeTidbFull(broken)
+
+    def __call__(self, test, node, timeout=10.0):
+        factory = self
+
+        class _S:
+            def run(self, sql):
+                try:
+                    return factory.state.run(sql)
+                except _FakeSqlError as e:
+                    from jepsen_tpu.control.core import RemoteError
+
+                    raise RemoteError("mysql failed", exit=1, out="",
+                                      err=str(e), cmd="mysql",
+                                      node=node)
+
+            def close(self):
+                pass
+
+        return _S()
+
+
+class TestNewWorkloads:
+    def test_register_linearizable(self):
+        t = run_workload(td.register_workload,
+                         {"keys": [0, 1], "ops_per_key": 40,
+                          "group_size": 3, "seed": 7,
+                          "gen_ops": 200},
+                         FakeFullFactory())
+        assert t["results"]["valid?"] is True, t["results"]
+
+    def test_set_and_set_cas(self):
+        for fn in (td.set_workload, td.set_cas_workload):
+            t = run_workload(fn, {"ops": 120, "gen_ops": 150},
+                             FakeFullFactory())
+            assert t["results"]["valid?"] is True, t["results"]
+
+    def test_sequential(self):
+        t = run_workload(td.sequential_workload,
+                         {"ops": 80, "gen_ops": 120},
+                         FakeFullFactory())
+        assert t["results"]["valid?"] in (True, "unknown"), \
+            t["results"]
+
+    def test_monotonic_healthy_and_reordered(self):
+        t = run_workload(td.monotonic_workload,
+                         {"ops": 60, "gen_ops": 80},
+                         FakeFullFactory())
+        assert t["results"]["valid?"] is True, t["results"]
+        t = run_workload(td.monotonic_workload,
+                         {"ops": 60, "gen_ops": 80},
+                         FakeFullFactory(broken="mono-reorder"))
+        assert t["results"]["valid?"] is False
+
+    def test_txn_cycle(self):
+        t = run_workload(td.txn_cycle_workload,
+                         {"ops": 150, "seed": 5, "gen_ops": 200},
+                         FakeSqlFactory())
+        assert t["results"]["valid?"] is True, t["results"]
+
+    def test_table_healthy_and_ghost(self):
+        t = run_workload(td.table_workload,
+                         {"ops": 80, "seed": 2, "gen_ops": 100},
+                         FakeFullFactory())
+        assert t["results"]["valid?"] is True, t["results"]
+        t = run_workload(td.table_workload,
+                         {"ops": 120, "seed": 2, "gen_ops": 150},
+                         FakeFullFactory(broken="ghost-table"))
+        assert t["results"]["valid?"] is False
+
+    def test_bank_multitable(self):
+        t = run_workload(td.bank_multitable_workload,
+                         {"ops": 80, "gen_ops": 100},
+                         FakeFullFactory())
+        assert t["results"]["valid?"] is True, t["results"]
+
+    def test_menu_matches_reference(self):
+        # tidb/core.clj:32-60 workload names
+        assert set(td.WORKLOADS) == {
+            "bank", "bank-multitable", "long-fork", "monotonic",
+            "txn-cycle", "append", "register", "set", "set-cas",
+            "sequential", "table"}
