@@ -33,6 +33,7 @@ import random
 from collections import defaultdict
 
 from .. import checker as chk
+from .. import generator as gen
 from .. import history as h
 from ..checker import _Fn
 from ..history import History
@@ -246,7 +247,9 @@ class Analysis:
         """§3: every ok send at or below a key's highest *polled*
         offset must have been polled by someone (else: lost-write);
         acknowledged sends above it that nobody ever polled are
-        'unseen' (informational unless never observed at all)."""
+        'unseen' — an error at history end (check() flags any
+        leftover unseen; the workload's final drain phase exists so
+        healthy runs come back clean)."""
         highest_polled: dict = {}
         for t, k, off, _val, kind in self.obs:
             if kind == "poll":
@@ -380,19 +383,21 @@ class Analysis:
         for t in txns:
             if t.type != h.OK:
                 continue
-            highest: dict = {}
+            # wr-graph (kafka.clj:1840-1852): writer of v -> EVERY txn
+            # that polled v, for every polled value (a highest-only
+            # link misses cycles closed through older reads)
+            linked: set = set()
             for m in _mop_polls(t.mops):
                 if len(m) > 1 and isinstance(m[1], dict):
                     for k, pairs in m[1].items():
                         for _off, val in pairs:
-                            r = self.rank.get((k, val))
-                            if r is not None and r >= highest.get(
-                                    k, (-1, None))[0]:
-                                highest[k] = (r, val)
-            for k, (_r, val) in highest.items():
-                w = self.writer_of.get((k, val))
-                if w is not None and w is not t and w.type != h.FAIL:
-                    edges.append((index[id(w)], index[id(t)], elle.WR))
+                            w = self.writer_of.get((k, val))
+                            if (w is not None and w is not t
+                                    and w.type != h.FAIL
+                                    and id(w) not in linked):
+                                linked.add(id(w))
+                                edges.append((index[id(w)],
+                                              index[id(t)], elle.WR))
         committed = []
         for i, t in enumerate(txns):
             if t.type == h.OK:
@@ -420,12 +425,31 @@ def check(hist, opts: dict | None = None) -> dict:
     if a.ww_deps:
         allowed |= {"G1c", "G1c-process", "G1c-realtime"}
     errors = {k: v for k, v in a.errors.items() if v}
+    if a.unseen:
+        # kafka.clj's last-unseen: acked sends nobody ever polled are
+        # an error at history end (the workload's final polls drain,
+        # so healthy runs come back clean)
+        errors["unseen"] = [
+            {"key": k, "count": len(vs), "messages": sorted(vs)[:32]}
+            for k, vs in sorted(a.unseen.items())]
     bad = sorted(k for k in errors if k not in allowed)
+    # condense-error ordering: skip/nonmonotonic families sort by how
+    # far the offset jumped (worst first)
+    _DELTA = {"poll-skip", "int-poll-skip", "int-send-skip"}
+    _NEG_DELTA = {"nonmonotonic-poll", "nonmonotonic-send",
+                  "int-nonmonotonic-poll", "int-nonmonotonic-send"}
+    out_errors = {}
+    for k, v in errors.items():
+        if k in _DELTA:
+            v = sorted(v, key=lambda e: -e.get("delta", 0))
+        elif k in _NEG_DELTA:
+            v = sorted(v, key=lambda e: e.get("delta", 0))
+        out_errors[k] = v[:8]
     return {
         "valid?": not bad,
         "error-types": sorted(errors.keys()),
         "bad-error-types": bad,
-        "errors": {k: v[:8] for k, v in errors.items()},
+        "errors": out_errors,
         "unseen": {k: len(v) for k, v in a.unseen.items()},
     }
 
@@ -444,17 +468,57 @@ def checker(opts: dict | None = None) -> chk.Checker:
     return _Fn(run)
 
 
+class _DrainGen(gen.Generator):
+    """Emits polls until this thread's LAST completed poll returned
+    no pairs (caught up with the tail). Functional: state advances on
+    completion events via update(), never on probes."""
+
+    def __init__(self, done: bool = False):
+        self.done = done
+
+    def op(self, test, ctx):
+        if self.done:
+            return None, self
+        m = gen.fill_in_op({"f": "poll", "value": [["poll"]]}, ctx)
+        if m is gen.PENDING:
+            return gen.PENDING, self
+        return m, self
+
+    def update(self, test, ctx, event):
+        if (event.type == h.OK and event.f == "poll"
+                and isinstance(event.value, list)):
+            polled = any(
+                m[0] == "poll" and len(m) > 1
+                and isinstance(m[1], dict) and any(m[1].values())
+                for m in event.value)
+            if not polled:
+                return _DrainGen(done=True)
+        return self
+
+
 def workload(opts: dict | None = None) -> dict:
     from .. import generator as gen
 
     o = dict(opts or {})
-    g = generator(n_keys=o.get("n-keys", 4),
+    n_keys = o.get("n-keys", 4)
+    g = generator(n_keys=n_keys,
                   max_txn=o.get("max-txn-length", 4),
                   seed=o.get("seed"))
     if o.get("ops"):
         g = gen.limit(o["ops"], g)
+    # final drain (the reference's final-polls loop, kafka.clj
+    # 405-432: repeat assign+poll until caught up): every thread takes
+    # ownership of all keys, then polls until a poll comes back EMPTY
+    # (the log tail), bounded by final-polls as a safety cap — so
+    # acked-but-unpolled sends don't read as 'unseen' errors. Clients
+    # with bounded poll batches drain across iterations.
+    keys = list(range(n_keys))
+    final = gen.each_thread(gen.phases(
+        gen.once(lambda: {"f": "assign", "value": keys}),
+        gen.limit(o.get("final-polls", 32), _DrainGen())))
     return {
         "generator": g,
+        "final_generator": final,
         "checker": chk.compose({"kafka": checker(o),
                                 "stats": chk.stats()}),
     }

@@ -71,13 +71,48 @@ class TestAnomalies:
         assert res["valid?"] is False
         assert "lost-write" in res["bad-error-types"], res
 
-    def test_unseen_is_informational(self):
-        # acked above the highest polled offset: unseen, not lost
+    def test_unseen_is_an_error(self):
+        # acked above the highest polled offset: not lost, but if
+        # nobody EVER polls it, the history ends with an unseen error
+        # (kafka.clj last-unseen -> :errors)
         h = flat(send_ok(0, 0, 0, 1), send_ok(0, 0, 1, 2),
                  poll_ok(1, {0: [[0, 1]]}))
         res = kafka.check(h)
-        assert res["valid?"] is True, res
+        assert res["valid?"] is False, res
+        assert "unseen" in res["bad-error-types"]
         assert res["unseen"] == {0: 1}
+        assert res["errors"]["unseen"][0] == {
+            "key": 0, "count": 1, "messages": [2]}
+
+    def test_drained_history_has_no_unseen(self):
+        h = flat(send_ok(0, 0, 0, 1), send_ok(0, 0, 1, 2),
+                 poll_ok(1, {0: [[0, 1], [1, 2]]}))
+        res = kafka.check(h)
+        assert res["valid?"] is True, res
+        assert res["unseen"] == {}
+
+    def test_wr_links_all_reads_not_just_highest(self):
+        # T2 polls k0 and sees BOTH T1's value (rank 0) and T3's
+        # (rank 1): the wr edge T1->T2 must exist even though T1's
+        # value is not T2's highest read — the cycle with T2->T1 via
+        # k1 closes only through that older read (wr-graph,
+        # kafka.clj:1840-1852).
+        h = flat(
+            send_ok(3, 0, 1, 30),  # k0 rank 1 writer (the highest)
+            (("invoke", 1, "txn", [["send", 0, 10], ["poll"]]),
+             ("invoke", 2, "txn", [["send", 1, 20], ["poll"]]),
+             # TA: writes k0=10 (rank 0), polls k1 and sees 20
+             ("ok", 1, "txn", [["send", 0, [0, 10]],
+                               ["poll", {1: [[0, 20]]}]]),
+             # TB: writes k1=20, polls k0 seeing BOTH ranks —
+             # TA's value is NOT its highest read
+             ("ok", 2, "txn", [["send", 1, [0, 20]],
+                               ["poll", {0: [[0, 10], [1, 30]]}]])),
+        )
+        res = kafka.check(h, {"ww-deps": False})
+        assert any(t.startswith("G1c") for t in res["error-types"]), \
+            res
+        assert res["valid?"] is False
 
     def test_duplicate_offsets(self):
         # same value observed at two offsets
