@@ -478,7 +478,7 @@ class _DrainGen(gen.Generator):
 
     def op(self, test, ctx):
         if self.done:
-            return None, self
+            return None  # exhausted (the op() protocol's bare None)
         m = gen.fill_in_op({"f": "poll", "value": [["poll"]]}, ctx)
         if m is gen.PENDING:
             return gen.PENDING, self
