@@ -157,6 +157,7 @@ def main(argv=None) -> None:
     commands.update(cli.test_all_cmd(make_all_tests,
                                      parser_fn=_workload_opt))
     commands.update(cli.serve_cmd())
+    commands.update(cli.telemetry_cmd())
     cli.run_cli(commands, argv)
 
 

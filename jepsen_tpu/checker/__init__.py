@@ -21,6 +21,7 @@ from collections import Counter
 from typing import Any, Callable
 
 from .. import history as h
+from .. import telemetry
 from .. import util
 from ..history import History, Op
 from . import models as model
@@ -106,7 +107,10 @@ class Compose(Checker):
 
         def one(kv):
             name, c = kv
-            r = check_safe(c, test, hist, sub_opts)
+            # per-checker timing: the checker:<name> spans feed the
+            # :telemetry summary core.analyze attaches to results
+            with telemetry.span(f"checker:{name}"):
+                r = check_safe(c, test, hist, sub_opts)
             if partial is not None:
                 try:
                     partial.put(name, r)

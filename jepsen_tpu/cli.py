@@ -258,6 +258,47 @@ def test_all_cmd(tests_fn, parser_fn=None, opt_fn=None) -> dict:
     return {"test-all": {"parser_fn": build, "run": run}}
 
 
+def telemetry_cmd() -> dict:
+    """A 'telemetry' subcommand: prints the span-tree + metrics
+    summary for a stored run (its telemetry.jsonl / metrics.json
+    artifacts; see doc/observability.md)."""
+    def build(p):
+        p.add_argument("test", nargs="?", default="latest",
+                       help="A store directory, or a test name "
+                            "(resolved under the store base).")
+        p.add_argument("--timestamp", default="latest",
+                       help="Which run of the named test.")
+        p.add_argument("--store", default=None,
+                       help="Store base directory (default ./store).")
+        return p
+
+    def run(options):
+        from pathlib import Path
+
+        from . import store as jstore
+        from .reports import telemetry as rtel
+
+        base = Path(options.store) if options.store else jstore.BASE
+        d = Path(options.test)
+        if not d.is_dir():
+            d = base / options.test / options.timestamp
+        if options.test == "latest" and not d.is_dir():
+            d = base / "latest"
+        if not d.is_dir():
+            print(f"no such stored test: {options.test}")
+            return 254
+        events, metrics = jstore.load_telemetry(d)
+        if not events and metrics is None:
+            print(f"no telemetry recorded under {d} "
+                  "(run predates the telemetry layer?)")
+            return 1
+        print(f"# {d.resolve()}\n")
+        print(rtel.telemetry_text(events, metrics))
+        return 0
+
+    return {"telemetry": {"parser_fn": build, "run": run}}
+
+
 def serve_cmd() -> dict:
     """A 'serve' subcommand for the web UI (cli.clj:336-354)."""
     def build(p):

@@ -23,6 +23,7 @@ from . import control
 from . import db as jdb
 from . import interpreter
 from . import nemesis as jnemesis
+from . import telemetry
 from . import util
 from .history import History
 
@@ -194,12 +195,17 @@ def analyze(test: dict, store_ctx=None) -> dict:
             "profile?"):
         trace_dir = store_ctx.path(test, "xprof")
     try:
-        with util.profile_trace(trace_dir):
-            test["results"] = jchecker.check_safe(checker, test,
-                                                  test["history"], opts)
+        with telemetry.span("analyze"):
+            with util.profile_trace(trace_dir):
+                test["results"] = jchecker.check_safe(
+                    checker, test, test["history"], opts)
     finally:
         if partial is not None:
             partial.close()
+    # per-checker timings + phase/kernel counters ride in the results
+    # (and therefore results.json) next to the verdict they explain
+    if isinstance(test.get("results"), dict):
+        test["results"]["telemetry"] = telemetry.get().summary()
     logger.info("Analysis complete")
     return test
 
@@ -237,27 +243,46 @@ def run(test: dict) -> dict:
             store_ctx = None
 
     try:
+        # analyze runs INSIDE the relative-time scope so its telemetry
+        # spans share the run's clock origin (and line up with op
+        # times); nothing in analysis reads the ambient origin itself.
         with util.with_relative_time():
-            test = control.open_sessions(test)
+            telemetry.reset()
             try:
-                _setup_os(test)
-                try:
-                    _db_cycle(test)
+                with telemetry.span("run", test=test.get("name")):
+                    test = control.open_sessions(test)
                     try:
-                        test = run_case(test)
-                        if store_ctx:
-                            store_ctx.save_history(test)
-                        snarf_logs(test)
+                        with telemetry.span("os-setup"):
+                            _setup_os(test)
+                        try:
+                            with telemetry.span("db-cycle"):
+                                _db_cycle(test)
+                            try:
+                                with telemetry.span("case"):
+                                    test = run_case(test)
+                                if store_ctx:
+                                    store_ctx.save_history(test)
+                                with telemetry.span("snarf-logs"):
+                                    snarf_logs(test)
+                            finally:
+                                with telemetry.span("teardown-db"):
+                                    _teardown_db(test)
+                        finally:
+                            with telemetry.span("teardown-os"):
+                                _teardown_os(test)
                     finally:
-                        _teardown_db(test)
-                finally:
-                    _teardown_os(test)
-            finally:
-                control.close_sessions(test)
+                        control.close_sessions(test)
 
-        test = analyze(test, store_ctx)
-        if store_ctx:
-            store_ctx.save_results(test)
+                test = analyze(test, store_ctx)
+                if store_ctx:
+                    store_ctx.save_results(test)
+            finally:
+                # even a crashed run leaves its trace behind
+                if store_ctx and test.get("store_dir"):
+                    try:
+                        telemetry.save(test["store_dir"])
+                    except Exception:  # noqa: BLE001 — best-effort
+                        logger.exception("saving telemetry failed")
     finally:
         # a crashed lifecycle must not leak the per-test log handler
         if store_ctx:

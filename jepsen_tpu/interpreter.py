@@ -20,6 +20,7 @@ from typing import Any
 
 from . import client as jclient
 from . import generator as gen
+from . import telemetry
 from . import util
 from .generator.context import NEMESIS
 from .history import History, Op
@@ -104,30 +105,63 @@ def spawn_worker(test, out: queue.Queue, worker: Worker, wid):
     inq: queue.Queue = queue.Queue(maxsize=1)
 
     def run():
+        import time as _t
+
         w = worker.open(test, wid)
+        # per-op stats accumulate locally and flush once at exit: the
+        # hot loop must not contend on the recorder's lock across all
+        # worker threads (the throughput floor test polices this path)
+        tel = telemetry.get()
+        epoch0 = tel.epoch
+        invoke_ns = 0
+        type_counts: dict = {}
+        crashes = 0
         try:
             while True:
                 op = inq.get()
+                t0 = None
                 try:
                     if op.type == "exit":
                         return
                     if op.type == "sleep":
-                        import time as _t
                         _t.sleep(op.value)
                         out.put(op)
                     elif op.type == "log":
                         logger.info("%s", op.value)
                         out.put(op)
                     else:
+                        t0 = _t.monotonic_ns()
                         op2 = w.invoke(test, op)
+                        invoke_ns += _t.monotonic_ns() - t0
+                        t0 = None
+                        type_counts[op2.type] = type_counts.get(
+                            op2.type, 0) + 1
                         out.put(op2)
                 except Exception as e:  # noqa: BLE001 - crash becomes :info
+                    if t0 is not None:
+                        # crashed invokes still spent client time (a
+                        # 30s timeout-then-raise is exactly the kind
+                        # of wait this counter exists to expose)
+                        invoke_ns += _t.monotonic_ns() - t0
                     logger.warning("Process %s crashed: %s", op.process, e)
+                    crashes += 1
                     out.put(op.copy(
                         type="info",
                         exception=traceback.format_exc(),
                         error=f"indeterminate: {e}"))
         finally:
+            # abnormal interpreter exits signal workers but don't join
+            # them, so this finally may fire after a LATER run reset
+            # the recorder — the epoch check keeps a straggler's tallies
+            # out of that run's metrics (the crashed run's artifacts
+            # simply miss this worker's counts, which is best-effort)
+            if tel.epoch == epoch0:
+                if invoke_ns:
+                    tel.count("interpreter.invoke_ns", invoke_ns)
+                for ty, n in type_counts.items():
+                    tel.count(f"interpreter.ops.{ty}", n)
+                if crashes:
+                    tel.count("interpreter.worker-crashes", crashes)
             try:
                 w.close(test)
             except Exception:  # noqa: BLE001
@@ -173,6 +207,10 @@ def run(test: dict) -> dict:
     op_index = 0
     outstanding = 0
     poll_timeout_us = 0
+    # local tallies, flushed once below — no recorder locking in the
+    # hot loop (same rule as the worker threads)
+    dispatched = 0
+    stalls = 0
     try:
         while True:
             op2 = None
@@ -225,6 +263,7 @@ def run(test: dict) -> dict:
             if op_ is gen.PENDING:
                 # Keep the pre-call generator state, like the reference
                 # (interpreter.clj:290-291).
+                stalls += 1
                 poll_timeout_us = MAX_PENDING_INTERVAL_US
                 continue
 
@@ -242,6 +281,7 @@ def run(test: dict) -> dict:
                 writer.append(op_)
                 op_index += 1
             invocations[thread].put(op_)
+            dispatched += 1
             ctx = ctx.busy_thread(op_.time, thread)
             g = gen.update(g2, test, ctx, op_)
             outstanding += 1
@@ -255,3 +295,9 @@ def run(test: dict) -> dict:
                 except queue.Full:
                     pass
         raise
+    finally:
+        tel = telemetry.get()
+        if dispatched:
+            tel.count("interpreter.dispatched", dispatched)
+        if stalls:
+            tel.count("interpreter.generator-stalls", stalls)

@@ -174,10 +174,13 @@ static int walk_append_txn(walk_state *w, int64_t ti, int code,
             else if (PyUnicode_CompareWithASCIIString(f, "r") == 0)
                 is_r = 1;
         }
-        if (!is_append && !is_r) { Py_DECREF(mfast); continue; }
+        /* intern BEFORE the f dispatch: the Python flattener assigns
+         * key ids to every mop, so unknown mop types must still claim
+         * their intern slot or the two paths' key ids diverge */
         int64_t kid = intern_key(w->kdict, h->keys, k);
         if (kid == -1) { Py_DECREF(mfast); Py_DECREF(fast); return -1; }
         if (kid == -2) { Py_DECREF(mfast); Py_DECREF(fast); return 1; }
+        if (!is_append && !is_r) { Py_DECREF(mfast); continue; }
         if (kgrow(&w->own, kid) < 0) {
             Py_DECREF(mfast); Py_DECREF(fast); return -1;
         }
@@ -253,10 +256,12 @@ static int walk_rw_txn(walk_state *w, int64_t ti, int code,
             else if (PyUnicode_CompareWithASCIIString(f, "r") == 0)
                 is_r = 1;
         }
-        if (!is_w && !is_r) { Py_DECREF(mfast); continue; }
+        /* intern before the f dispatch — key-id parity with the
+         * Python flattener (see walk_append_txn) */
         int64_t kid = intern_key(w->kdict, h->keys, k);
         if (kid == -1) { Py_DECREF(mfast); rc = -1; break; }
         if (kid == -2) { Py_DECREF(mfast); rc = 1; break; }
+        if (!is_w && !is_r) { Py_DECREF(mfast); continue; }
         if (kgrow(&w->own, kid) < 0 || kgrow(&w->expected, kid) < 0
                 || kgrow(&w->lastread, kid) < 0
                 || kgrow(&w->erseen, kid) < 0
@@ -341,10 +346,14 @@ static int walk_rw_txn(walk_state *w, int64_t ti, int code,
  * Returns a handle, or NULL on allocation/python error (caller falls
  * back to the Python flattener). */
 void *ef_flatten(PyObject *ops, int64_t kind) {
-    if (ensure_names() < 0) return NULL;
-    if (!PyList_Check(ops)) return NULL;
+    /* every NULL return must leave the error indicator CLEAR: under
+     * ctypes.PyDLL a pending exception would be raised from ef_flatten
+     * itself, bypassing the caller's RuntimeError -> Python-fallback
+     * contract (the fail: label below does the same) */
+    if (ensure_names() < 0) { PyErr_Clear(); return NULL; }
+    if (!PyList_Check(ops)) { PyErr_Clear(); return NULL; }
     ef_handle *h = (ef_handle *)calloc(1, sizeof(ef_handle));
-    if (!h) return NULL;
+    if (!h) { PyErr_Clear(); return NULL; }
     h->keys = PyList_New(0);
     PyObject *kdict = NULL, *open = NULL;
     walk_state w;
@@ -365,6 +374,13 @@ void *ef_flatten(PyObject *ops, int64_t kind) {
         PyObject *typ = PyObject_GetAttr(op, s_type);
         if (!typ) { Py_DECREF(proc); goto fail; }
         int code = -1;
+        if (!PyUnicode_Check(typ)) {
+            /* non-string type: skip the op like the host path does —
+             * PyUnicode_CompareWithASCIIString on a non-string is
+             * undefined behavior (mirrors the mop `f` guard) */
+            Py_DECREF(typ); Py_DECREF(proc);
+            continue;
+        }
         if (typ == s_invoke
                 || PyUnicode_CompareWithASCIIString(typ, "invoke") == 0) {
             PyObject *pp = PyLong_FromSsize_t(pos);

@@ -12,6 +12,7 @@ import random
 import threading
 from typing import Any, Callable, Iterable
 
+from .. import telemetry
 from ..history import Op
 
 
@@ -48,20 +49,25 @@ class InvalidNemesisCompletion(Exception):
 
 
 class Validate(Nemesis):
-    """Asserts nemesis protocol invariants (nemesis.clj:50-91)."""
+    """Asserts nemesis protocol invariants (nemesis.clj:50-91). Every
+    nemesis call passes through here (core.run_case wraps the test's
+    nemesis in validate()), so this is also where fault activations
+    get their telemetry spans."""
 
     def __init__(self, nemesis: Nemesis):
         self.nemesis = nemesis
 
     def setup(self, test):
-        res = self.nemesis.setup(test)
+        with telemetry.span("nemesis:setup"):
+            res = self.nemesis.setup(test)
         if not isinstance(res, Nemesis):
             raise InvalidNemesisCompletion(
                 f"setup should return a Nemesis, got {res!r}")
         return Validate(res)
 
     def invoke(self, test, op):
-        op2 = self.nemesis.invoke(test, op)
+        with telemetry.span(f"nemesis:{op.f}"):
+            op2 = self.nemesis.invoke(test, op)
         if not isinstance(op2, Op):
             raise InvalidNemesisCompletion(
                 f"invoke should return an Op, got {op2!r}")
@@ -71,7 +77,8 @@ class Validate(Nemesis):
         return op2
 
     def teardown(self, test):
-        self.nemesis.teardown(test)
+        with telemetry.span("nemesis:teardown"):
+            self.nemesis.teardown(test)
 
     def fs(self):
         return self.nemesis.fs()

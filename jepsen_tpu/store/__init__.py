@@ -11,6 +11,8 @@ Layout:
     history.jlog   incremental CRC-framed op log (store.format)
     results.json   save-2: checker results
     jepsen.log     per-test log output
+    telemetry.jsonl  span trace (jepsen_tpu.telemetry, doc/observability.md)
+    metrics.json   aggregated span/counter/gauge metrics
     <node>/...     downloaded node logs (core.snarf_logs)
   store/<name>/latest  -> most recent run   store/latest -> same
   store/current        -> run in progress
@@ -162,6 +164,18 @@ def load_results(d) -> dict | None:
             got["partial?"] = True
             return got
     return None
+
+
+def load_telemetry(d) -> tuple[list, dict | None]:
+    """(span events, metrics) from a stored test dir's telemetry
+    artifacts (telemetry.jsonl / metrics.json); ([], None) when the
+    run predates the telemetry layer."""
+    from .. import telemetry as tel
+
+    d = Path(d)
+    events = list(tel.read_events(d / tel.TRACE_FILE))
+    metrics = tel.read_metrics(d / tel.METRICS_FILE)
+    return events, metrics
 
 
 def load(name_or_dir, timestamp: str = "latest",

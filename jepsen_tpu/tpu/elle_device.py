@@ -29,6 +29,7 @@ from collections import defaultdict
 import numpy as np
 
 from .. import history as h
+from .. import telemetry
 from ..history import History
 from . import scc as scc_mod
 from .elle import (EDGE_NAMES, PROC, RT, RW, WR, WW, Txn, _classify,
@@ -223,10 +224,12 @@ class DeviceAppendAnalysis:
 
         try:
             arrs, keys = native.elle_flatten(self._ops, self._KIND)
+            telemetry.count("elle.flatten.native")
             return self._FLAT_CLS.from_native(self._ops, arrs, keys)
         except native.NotVectorizable as e:
             raise Unvectorizable(str(e)) from e
         except RuntimeError:
+            telemetry.count("elle.flatten.python")
             self.txns = collect(hist)
             return self._FLAT_CLS(self.txns)
 
@@ -611,7 +614,13 @@ def check_list_append_device(hist, device: bool = True) -> dict:
     Unvectorizable when the history can't be interned."""
     if not isinstance(hist, History):
         hist = History(hist)
-    a = DeviceAppendAnalysis(hist, device=device)
+    with telemetry.span("elle:list-append") as sp:
+        a = DeviceAppendAnalysis(hist, device=device)
+        if sp is not None:
+            sp["attrs"] = {"txns": a.flat.n,
+                           "edges": int(len(a.edge_src))}
+    telemetry.count("elle.txns", a.flat.n)
+    telemetry.count("elle.edges", int(len(a.edge_src)))
     anomalies = dict(a.anomalies)
     for name, ws in cycle_anomalies_arrays(
             a.flat.n, a.edge_src, a.edge_dst, a.edge_ty, a._op,
@@ -959,7 +968,13 @@ def check_rw_register_device(hist, device: bool = True) -> dict:
     Unvectorizable when the history can't be interned."""
     if not isinstance(hist, History):
         hist = History(hist)
-    a = DeviceRwAnalysis(hist, device=device)
+    with telemetry.span("elle:rw-register") as sp:
+        a = DeviceRwAnalysis(hist, device=device)
+        if sp is not None:
+            sp["attrs"] = {"txns": a.flat.n,
+                           "edges": int(len(a.edge_src))}
+    telemetry.count("elle.txns", a.flat.n)
+    telemetry.count("elle.edges", int(len(a.edge_src)))
     anomalies = dict(a.anomalies)
     for name, ws in cycle_anomalies_arrays(
             a.flat.n, a.edge_src, a.edge_dst, a.edge_ty, a._op,

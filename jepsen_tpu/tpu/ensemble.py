@@ -19,8 +19,9 @@ import numpy as np
 
 from functools import lru_cache
 
+from .. import telemetry
 from .encode import Encoded
-from .wgl import PackedBatch, _kernel, _next_pow2
+from .wgl import PackedBatch, _drain, _kernel, _next_pow2, _timed_launch
 
 
 @lru_cache(maxsize=None)
@@ -32,10 +33,12 @@ def _jitted_sharded(mesh, W: int, F: int, max_iters: int, reach: bool):
 
     repl = NamedSharding(mesh, P())
     shard = NamedSharding(mesh, P("b"))
+    # the trailing output is the scalar iteration count (replicated)
     return jax.jit(
         partial(_kernel, W=W, F=F, max_iters=max_iters, reach=reach),
         in_shardings=(repl, repl, repl, repl, repl, shard, shard),
-        out_shardings=(shard, shard) if reach else shard)
+        out_shardings=((shard, shard, repl) if reach
+                       else (shard, repl)))
 
 
 def default_mesh(n_devices: int | None = None):
@@ -86,10 +89,16 @@ def check_batch_sharded(encs: Sequence[Encoded], mesh=None, W: int = 32,
     fn = _jitted_sharded(mesh, W, F, pb.M + 4, reach)
     args = (pb.inv_t, pb.ret_t, pb.trans, pb.m, pb.sufmin,
             row_seg, st0)
-    out = fn(*args)
+    # the (mesh, ...) bucket is disjoint from wgl._launch's by shape
+    bucket = (mesh, pb.inv_t.shape, pb.trans.shape[2], len(padded),
+              W, F, pb.M + 4, reach)
+    telemetry.count("wgl.ensemble.launches")
+    telemetry.count("wgl.kernel.rows", len(padded))
+    out = _timed_launch(bucket, lambda: fn(*args))
     if reach:
-        return (np.asarray(out[0])[:n_rows], np.asarray(out[1])[:n_rows])
-    return np.asarray(out)[:n_rows]
+        mask, unk = _drain(out, reach=True)
+        return mask[:n_rows], unk[:n_rows]
+    return _drain(out, reach=False)[:n_rows]
 
 
 def analysis_batch_sharded(model, hists, mesh=None, W: int | None = None,

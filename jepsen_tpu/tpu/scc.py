@@ -40,6 +40,8 @@ from functools import lru_cache
 
 import numpy as np
 
+from .. import telemetry
+
 # Caps: sweeps per fixpoint and outer peeling rounds. Each fixpoint
 # sweep is O(E) on device, so generous caps cost little; they exist to
 # bound adversarial graphs, which then take the host fallback.
@@ -200,15 +202,20 @@ def scc(n: int, src, dst, emask=None, device: bool = True) -> np.ndarray:
     n_live = len(src) if emask is None else int(emask.sum())
     if n == 0 or n_live == 0:
         return np.arange(n, dtype=np.int32)
+    telemetry.count("scc.nodes", n)
+    telemetry.count("scc.edges", n_live)
     if device and n_live >= DEVICE_MIN_EDGES:
         try:
             labels = scc_device(n, src, dst, emask)
         except Exception:
             labels = None
         if labels is not None:
+            telemetry.count("scc.path.device")
             return labels
+        telemetry.count("scc.device-nonconverged")
     if emask is not None:
         src, dst = src[emask], dst[emask]
+    telemetry.count("scc.path.host")
     return _scc_host(n, src, dst)
 
 
@@ -223,8 +230,12 @@ def nontrivial_from_labels(labels: np.ndarray) -> list[np.ndarray]:
     order = np.argsort(inverse, kind="stable")
     sorted_inv = inverse[order]
     bounds = np.concatenate([[0], np.cumsum(counts)])
-    return [order[bounds[i]:bounds[i + 1]]
-            for i in np.flatnonzero(big)]
+    groups = [order[bounds[i]:bounds[i + 1]]
+              for i in np.flatnonzero(big)]
+    telemetry.count("scc.nontrivial-components", len(groups))
+    telemetry.gauge_max("scc.largest-component",
+                        int(max(len(g) for g in groups)))
+    return groups
 
 
 def nontrivial_sccs(n: int, src, dst, emask=None, device: bool = True

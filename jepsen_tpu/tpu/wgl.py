@@ -32,11 +32,14 @@ algorithm but re-shape it for SIMD:
 from __future__ import annotations
 
 import functools
+import threading as _threading
+import time as _time
 from typing import Any, Sequence
 
 import numpy as np
 
 from .. import history as h
+from .. import telemetry
 from ..checker import models as model_mod
 from ..history import History
 from .encode import INF, Encoded, EncodingError, encode
@@ -322,6 +325,18 @@ class PackedBatch:
             self.trans[b, :mm, :e.n_states] = e.trans
             self.sufmin[b, mm] = BIG
             self.sufmin[b, :mm] = np.minimum.accumulate(ret_r[::-1])[::-1]
+        # batch shape profile: real entries vs padded slots — the
+        # bucketing waste a tuning loop needs to see
+        used = int(self.m.sum())
+        slots = int(B * M)
+        tel = telemetry.get()
+        tel.count("wgl.batch.histories", B)
+        tel.count("wgl.batch.entries", used)
+        tel.count("wgl.batch.slots", slots)
+        if slots:
+            tel.gauge("wgl.batch.occupancy", round(used / slots, 4))
+            tel.gauge("wgl.batch.padding-waste",
+                      round(1 - used / slots, 4))
 
     def rows(self, rows: Sequence[tuple[int, int]]):
         """(row_seg, st0) int32 arrays for (segment, start-state) search
@@ -545,10 +560,53 @@ def _kernel(inv_t, ret_t, trans, mseg, sufmin, row_seg, st0,
     if debug:
         return p, mask, st, result, out_mask, ovf, it
     result = jnp.where(result == RUNNING, UNKNOWN, result)
+    # `it` rides along so callers can account while-loop iterations
+    # without a debug launch (see _drain)
     if reach:
         unknown = (result == UNKNOWN) | ovf
-        return out_mask, unknown
-    return result
+        return out_mask, unknown, it
+    return result, it
+
+
+# kernel shape buckets this process has already compiled: first launch
+# of a bucket is timed synchronously as compile (trace + XLA compile +
+# first execute); later launches stay async and cost only dispatch here
+_compiled_buckets: set = set()
+_buckets_lock = _threading.Lock()
+
+
+def _timed_launch(bucket, dispatch):
+    """Runs a kernel-dispatch thunk with first-launch-per-bucket
+    compile accounting. Shared by the single-device path below and the
+    mesh-sharded path (tpu/ensemble.py); their bucket tuples differ in
+    shape so one seen-set serves both. The bucket is CLAIMED under a
+    lock before measuring: concurrent checkers (compose fans out over
+    a thread pool) racing on the same bucket must record one compile,
+    not two — the loser's wait lands in execute time, where it
+    belongs."""
+    import jax
+
+    with _buckets_lock:
+        fresh = bucket not in _compiled_buckets
+        if fresh:
+            _compiled_buckets.add(bucket)
+    tel = telemetry.get()
+    t0 = _time.monotonic_ns()
+    try:
+        out = dispatch()
+    except BaseException:
+        # the claimed bucket never compiled: release it, or the real
+        # first compile on retry would be misrecorded as a plain launch
+        if fresh:
+            with _buckets_lock:
+                _compiled_buckets.discard(bucket)
+        raise
+    if fresh:
+        jax.block_until_ready(out)
+        tel.count("wgl.kernel.compiles")
+        tel.count("wgl.kernel.compile_ns", _time.monotonic_ns() - t0)
+    tel.count("wgl.kernel.launches")
+    return out
 
 
 def _launch(pb: PackedBatch, rows: Sequence[tuple[int, int]], W: int,
@@ -560,9 +618,31 @@ def _launch(pb: PackedBatch, rows: Sequence[tuple[int, int]], W: int,
             jnp.asarray(pb.trans), jnp.asarray(pb.m),
             jnp.asarray(pb.sufmin), jnp.asarray(row_seg),
             jnp.asarray(st0))
-    return _jitted_kernel()(*args, W=W, F=F, max_iters=pb.M + 4,
-                            reach=reach,
-                            crash_free=not pb.has_crashed)
+    bucket = (pb.inv_t.shape, pb.trans.shape[2], len(row_seg), W, F,
+              pb.M + 4, reach, pb.has_crashed)
+    telemetry.count("wgl.kernel.rows", len(row_seg))
+    return _timed_launch(bucket, lambda: _jitted_kernel()(
+        *args, W=W, F=F, max_iters=pb.M + 4, reach=reach,
+        crash_free=not pb.has_crashed))
+
+
+def _drain(out, reach: bool):
+    """Materializes a launch's outputs (blocking on the device),
+    recording the host wait as execute time plus the kernel's
+    while-loop iteration count. Returns result [B] (reach=False) or
+    (out_mask, unknown) arrays (reach=True)."""
+    tel = telemetry.get()
+    t0 = _time.monotonic_ns()
+    if reach:
+        mask, unk, it = out
+        res = (np.asarray(mask), np.asarray(unk))
+    else:
+        r, it = out
+        res = np.asarray(r)
+    n_it = int(it)
+    tel.count("wgl.kernel.execute_ns", _time.monotonic_ns() - t0)
+    tel.count("wgl.kernel.iterations", n_it)
+    return res
 
 
 def check_batch(encs: Sequence[Encoded], W: int = 32,
@@ -572,8 +652,8 @@ def check_batch(encs: Sequence[Encoded], W: int = 32,
     decide (window or frontier overflow) — fall back to search_host."""
     pb = PackedBatch(encs)
     rows = [(i, e.init_state) for i, e in enumerate(encs)]
-    res = _launch(pb, rows, W, F, reach=False)
-    return np.asarray(res)[:pb.B]
+    res = _drain(_launch(pb, rows, W, F, reach=False), reach=False)
+    return res[:pb.B]
 
 
 def check_batch_reach(encs: Sequence[Encoded], W: int = 32,
@@ -584,8 +664,8 @@ def check_batch_reach(encs: Sequence[Encoded], W: int = 32,
     pb = PackedBatch(encs)
     assert pb.S <= 32, "reach mode packs states into a uint32"
     rows = [(i, e.init_state) for i, e in enumerate(encs)]
-    out, unk = _launch(pb, rows, W, F, reach=True)
-    return np.asarray(out)[:pb.B], np.asarray(unk)[:pb.B]
+    out, unk = _drain(_launch(pb, rows, W, F, reach=True), reach=True)
+    return out[:pb.B], unk[:pb.B]
 
 
 # ---------------------------------------------------------------------------
@@ -672,6 +752,7 @@ class _SegmentCheckpoint:
             self._reset_needed = True
             return {}
         self._known = set(out)
+        telemetry.count("wgl.checkpoint.loaded", len(out))
         return out
 
     def _prepare(self):
@@ -712,6 +793,7 @@ class _SegmentCheckpoint:
                                  _z.crc32(payload)))
             f.write(payload)
         self._known.add((k, s))
+        telemetry.count("wgl.checkpoint.saved")
 
     def save(self, resolved: dict) -> None:
         for (k, s), m in resolved.items():
@@ -824,10 +906,11 @@ def check_segmented(enc: Encoded, target_len: int | None = None,
             kidx = {k: i for i, k in enumerate(ks)}
             pre_pb = PackedBatch([screen_segs[k][0] for k in ks])
             launch_rows = [(kidx[k], s) for k, s in screen_rows]
-            p_out, p_unk = _launch(pre_pb, launch_rows, W, F,
-                                   reach=True)
-            p_out = np.asarray(p_out)[:len(launch_rows)]
-            p_unk = np.asarray(p_unk)[:len(launch_rows)]
+            p_out, p_unk = _drain(
+                _launch(pre_pb, launch_rows, W, F, reach=True),
+                reach=True)
+            p_out = p_out[:len(launch_rows)]
+            p_unk = p_unk[:len(launch_rows)]
             for i, (k, s) in enumerate(screen_rows):
                 pre, exact = screen_segs[k]
                 mask = (search_host_reach(pre.with_init(s))
@@ -845,9 +928,10 @@ def check_segmented(enc: Encoded, target_len: int | None = None,
         # One packed copy per segment; rows share it via the kernel's
         # row->segment indirection.
         pb = PackedBatch(segs)
-        out, unk = _launch(pb, rows, W, F, reach=True)
-        out = np.asarray(out)[:len(rows)]
-        unk = np.asarray(unk)[:len(rows)]
+        out, unk = _drain(_launch(pb, rows, W, F, reach=True),
+                          reach=True)
+        out = out[:len(rows)]
+        unk = unk[:len(rows)]
         for i, (k, s) in enumerate(rows):
             resolved[(k, s)] = None if unk[i] else int(out[i])
     if ckpt is not None:
@@ -1029,7 +1113,7 @@ def analysis_batch_streamed(model, hists: Sequence, chunk: int = 256,
 
     def drain(entry):
         dev, encs, idx_map = entry
-        res = (np.asarray(dev)[:len(encs)] if dev is not None
+        res = (_drain(dev, reach=False)[:len(encs)] if dev is not None
                else [UNKNOWN] * len(encs))
         for j, i in enumerate(idx_map):
             r = int(res[j])

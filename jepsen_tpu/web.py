@@ -58,6 +58,8 @@ def home_html(base: Path | None = None) -> str:
             f"results</a></td>"
             f"<td><a href='/files/{_html.escape(rel)}/jepsen.log'>log"
             f"</a></td>"
+            f"<td><a href='/telemetry/{_html.escape(rel)}'>telemetry"
+            f"</a></td>"
             f"<td><a href='/zip/{_html.escape(rel)}'>zip</a></td>"
             f"</tr>")
     return ("<!DOCTYPE html><html><head><meta charset='utf-8'>"
@@ -67,7 +69,7 @@ def home_html(base: Path | None = None) -> str:
             "td, th { padding: 4px 10px; text-align: left }"
             "</style></head><body><h1>Jepsen</h1><table>"
             "<tr><th>Test</th><th>Time</th><th>Valid?</th>"
-            "<th colspan=3>Artifacts</th></tr>"
+            "<th colspan=4>Artifacts</th></tr>"
             + "".join(rows) + "</table></body></html>")
 
 
@@ -128,6 +130,21 @@ class StoreHandler(BaseHTTPRequestHandler):
                 else:
                     ctype = CONTENT_TYPES.get(p.suffix, "text/plain")
                     self._send(200, p.read_bytes(), ctype)
+            elif path.startswith("/telemetry/"):
+                rel = path[len("/telemetry/"):].rstrip("/")
+                p = self._resolve(rel)
+                if p is None or not p.is_dir():
+                    self._send(404, b"not found", "text/plain")
+                else:
+                    from .reports import telemetry as rtel
+
+                    events, metrics = jstore.load_telemetry(p)
+                    if not events and metrics is None:
+                        self._send(404, b"no telemetry recorded",
+                                   "text/plain")
+                    else:
+                        self._send(200, rtel.telemetry_html(
+                            rel, events, metrics).encode())
             elif path.startswith("/zip/"):
                 rel = path[len("/zip/"):].rstrip("/")
                 p = self._resolve(rel)

@@ -3,6 +3,7 @@
 import argparse
 import json
 import socket
+import urllib.error
 import urllib.request
 
 import pytest
@@ -125,6 +126,24 @@ def test_web_ui(tmp_path, monkeypatch):
         zipb = urllib.request.urlopen(
             base + "/zip/demo/20260729T000000.0000").read()
         assert zipb[:2] == b"PK"
+        # telemetry page: 404 without artifacts, rendered with them
+        with pytest.raises(urllib.error.HTTPError) as he:
+            urllib.request.urlopen(
+                base + "/telemetry/demo/20260729T000000.0000")
+        assert he.value.code == 404
+        (d / "telemetry.jsonl").write_text(
+            '{"id": 1, "parent": null, "name": "run", "t0": 0, '
+            '"t1": 5000000}\n')
+        (d / "metrics.json").write_text(
+            '{"spans": {}, "counters": {"wgl.kernel.launches": 2}, '
+            '"gauges": {}}')
+        page = urllib.request.urlopen(
+            base + "/telemetry/demo/20260729T000000.0000"
+        ).read().decode()
+        assert "run" in page and "wgl.kernel.launches" in page
+        assert "5.0ms" in page
+        home = urllib.request.urlopen(base + "/").read().decode()
+        assert "/telemetry/demo/" in home
         # raw-socket path traversal (urllib would normalize ..)
         with socket.create_connection(("127.0.0.1", port)) as s:
             s.sendall(b"GET /files/../../../etc/passwd HTTP/1.0\r\n"

@@ -147,3 +147,67 @@ class TestDifferential:
         ])
         with pytest.raises(native.NotVectorizable):
             native.elle_flatten(list(hist), 0)
+
+    def test_unknown_mop_types_intern_keys_like_python(self):
+        """Key-intern parity (round-5 advisor finding): the Python
+        flattener assigns a key id to EVERY mop before dispatching on
+        f, so an unknown mop type must still claim its intern slot in
+        the C pass — here 'zed' must intern before 'a'."""
+        mops1 = [["x", "zed", 0], ["append", "a", 1]]
+        hist = History([
+            op(type="invoke", process=0, f="txn", value=mops1),
+            op(type="ok", process=0, f="txn", value=mops1),
+            op(type="invoke", process=1, f="txn",
+               value=[["append", "zed", 2], ["r", "a", None]]),
+            op(type="ok", process=1, f="txn",
+               value=[["append", "zed", 2], ["r", "a", [1]]]),
+        ])
+        ops = list(hist)
+        arrs, keys = native.elle_flatten(ops, 0)
+        ref = elle_device.Flat(elle.collect(hist))
+        assert keys == ref.key_names == ["zed", "a"]
+        for f in APPEND_FIELDS:
+            want = getattr(ref, f, None)
+            if want is None:
+                continue
+            assert (np.asarray(arrs[f]) == np.asarray(want)).all(), f
+
+    def test_unknown_mop_types_intern_keys_rw(self):
+        mops1 = [["cas", "q", 7], ["w", "p", 1]]
+        hist = History([
+            op(type="invoke", process=0, f="txn", value=mops1),
+            op(type="ok", process=0, f="txn", value=mops1),
+            op(type="invoke", process=1, f="txn",
+               value=[["w", "q", 2], ["r", "p", None]]),
+            op(type="ok", process=1, f="txn",
+               value=[["w", "q", 2], ["r", "p", 1]]),
+        ])
+        ops = list(hist)
+        arrs, keys = native.elle_flatten(ops, 1)
+        ref = elle_device.RwFlat(elle.collect(hist))
+        assert keys == ref.key_names == ["q", "p"]
+        for f in RW_FIELDS:
+            want = getattr(ref, f, None)
+            if want is None:
+                continue
+            assert (np.asarray(arrs[f]) == np.asarray(want)).all(), f
+
+    def test_non_string_op_type_skipped(self):
+        """An op with a non-string :type must be skipped cleanly by
+        the C pass — the host path ignores it, and an unguarded
+        PyUnicode compare on it is undefined behavior (round-5
+        advisor finding)."""
+        ops = [
+            op(type="invoke", process=0, f="txn",
+               value=[["append", "k", 1]]),
+            op(type=7, process=0, f="txn",
+               value=[["append", "k", 2]]),
+            op(type=None, process=1, f="txn",
+               value=[["append", "k", 3]]),
+            op(type="ok", process=0, f="txn",
+               value=[["append", "k", 1]]),
+        ]
+        arrs, keys = native.elle_flatten(ops, 0)
+        assert len(arrs["t_type"]) == 1  # only the paired ok txn
+        assert list(arrs["ap_val"]) == [1]
+        assert keys == ["k"]
