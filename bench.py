@@ -262,6 +262,102 @@ def bench_headline(n_events):
     }
 
 
+def bench_monitor_overhead(n_ops=4000):
+    """Live-monitor + watchdog tax on the interpreter hot loop: the
+    same dummy-client run with and without the observers attached.
+    vs_baseline = monitored_rate / bare_rate (1.0 = free; the ISSUE-3
+    acceptance bound is 'rate-floor still passes', this line records
+    the actual delta)."""
+    import statistics as _st
+
+    from jepsen_tpu import client as jclient
+    from jepsen_tpu import interpreter, monitor, testing, util, watchdog
+    from jepsen_tpu import generator as gen
+
+    def one_run(monitored: bool) -> float:
+        t = testing.noop_test()
+        t.update(concurrency=8, client=jclient.noop,
+                 generator=gen.clients(gen.limit(
+                     n_ops, gen.repeat({"f": "write", "value": 1}))))
+        if monitored:
+            t["monitor"] = monitor.Monitor(t, interval_s=0.25)
+            t["watchdog"] = watchdog.from_test(
+                {"watchdog": ["register", "counter", "set"]})
+            t["monitor"].start()
+        util.init_relative_time()
+        t0 = time.time()
+        t = interpreter.run(dict(t))
+        dt = time.time() - t0
+        assert len(t["history"]) == 2 * n_ops
+        if monitored:
+            t["monitor"].stop()
+        return n_ops / dt
+
+    one_run(True)  # warm
+    bare = _st.median([one_run(False) for _ in range(3)])
+    mon = _st.median([one_run(True) for _ in range(3)])
+    _log(f"monitor-overhead: bare {bare:.0f} ops/s "
+         f"monitored {mon:.0f} ops/s ({mon / bare:.3f}x)")
+    return {
+        "metric": f"interpreter throughput with live monitor + "
+                  f"watchdog attached ({n_ops} dummy ops)",
+        "value": round(mon, 1),
+        "unit": "ops/s",
+        "vs_baseline": round(mon / bare, 3),
+    }
+
+
+def bench_watchdog_latency(n_ops=200_000):
+    """Online-violation detection cost: per-op observe() time through
+    all three adapters on a synthetic register stream, and the time
+    from feeding a violating completion to the watchdog tripping.
+    Baseline 1µs/op (well under a fast client round-trip)."""
+    import statistics as _st
+
+    from jepsen_tpu import watchdog
+    from jepsen_tpu.history import Op
+
+    ops = []
+    for i in range(n_ops // 2):
+        v = i % 5
+        ops.append(Op(index=2 * i, time=2 * i, type="invoke",
+                      process=i % 8, f="write", value=v))
+        ops.append(Op(index=2 * i + 1, time=2 * i + 1, type="ok",
+                      process=i % 8, f="read", value=v))
+    times = []
+    for _ in range(3):
+        wd = watchdog.from_test(
+            {"watchdog": ["register", "counter", "set"]})
+        t0 = time.time()
+        for op in ops:
+            wd.observe(op)
+        times.append(time.time() - t0)
+        assert not wd.tripped
+    per_op_us = _st.median(times) / len(ops) * 1e6
+    # detection latency: one violating completion, observe -> tripped
+    det = []
+    for _ in range(5):
+        wd = watchdog.from_test({"watchdog": ["register"]})
+        for op in ops[:64]:
+            wd.observe(op)
+        bad = Op(index=65, time=65, type="ok", process=0, f="read",
+                 value=999_999)
+        t0 = time.time()
+        wd.observe(bad)
+        det.append(time.time() - t0)
+        assert wd.tripped
+    det_us = _st.median(det) * 1e6
+    _log(f"watchdog: {per_op_us:.2f}µs/op through 3 adapters, "
+         f"{det_us:.1f}µs observe->tripped")
+    return {
+        "metric": "watchdog online-check latency "
+                  f"(per-op observe, {n_ops // 1000}k-op stream)",
+        "value": round(per_op_us, 3),
+        "unit": "us/op",
+        "vs_baseline": round(1.0 / per_op_us, 2) if per_op_us else 0.0,
+    }
+
+
 def _telemetry_lines():
     """Kernel-profile lines derived from the run's telemetry: the
     process-global recorder accumulated compile/execute time and batch
@@ -336,7 +432,9 @@ def main():
     small = n_events < 1_000_000
     lines = []
     if not os.environ.get("BENCH_SKIP_EXTRAS"):
-        for fn, args in ((bench_list_append,
+        for fn, args in ((bench_monitor_overhead, ()),
+                         (bench_watchdog_latency, ()),
+                         (bench_list_append,
                           (10_000 if small else 100_000,)),
                          (bench_rw_register,
                           (10_000 if small else 100_000,)),

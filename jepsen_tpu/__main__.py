@@ -158,6 +158,7 @@ def main(argv=None) -> None:
                                      parser_fn=_workload_opt))
     commands.update(cli.serve_cmd())
     commands.update(cli.telemetry_cmd())
+    commands.update(cli.trace_cmd())
     cli.run_cli(commands, argv)
 
 

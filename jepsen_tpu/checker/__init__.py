@@ -826,11 +826,13 @@ def log_file_pattern(pattern: str, filename: str) -> Checker:
 
 
 def perf(opts: dict | None = None) -> Checker:
-    """Latency + rate graphs (checker/perf.clj); see jepsen_tpu.checker.perf."""
-    from ..reports.perf import latency_graph, rate_graph
+    """Latency + rate + live-monitor graphs (checker/perf.clj plus the
+    monitor time-series plot); see jepsen_tpu.reports.perf."""
+    from ..reports.perf import latency_graph, monitor_graph, rate_graph
 
     return compose({"latency-graph": latency_graph(opts),
-                    "rate-graph": rate_graph(opts)})
+                    "rate-graph": rate_graph(opts),
+                    "monitor-graph": monitor_graph(opts)})
 
 
 def clock_plot() -> Checker:

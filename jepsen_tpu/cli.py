@@ -263,28 +263,14 @@ def telemetry_cmd() -> dict:
     summary for a stored run (its telemetry.jsonl / metrics.json
     artifacts; see doc/observability.md)."""
     def build(p):
-        p.add_argument("test", nargs="?", default="latest",
-                       help="A store directory, or a test name "
-                            "(resolved under the store base).")
-        p.add_argument("--timestamp", default="latest",
-                       help="Which run of the named test.")
-        p.add_argument("--store", default=None,
-                       help="Store base directory (default ./store).")
-        return p
+        return _store_run_opts(p)
 
     def run(options):
-        from pathlib import Path
-
         from . import store as jstore
         from .reports import telemetry as rtel
 
-        base = Path(options.store) if options.store else jstore.BASE
-        d = Path(options.test)
-        if not d.is_dir():
-            d = base / options.test / options.timestamp
-        if options.test == "latest" and not d.is_dir():
-            d = base / "latest"
-        if not d.is_dir():
+        d = _resolve_stored_run(options)
+        if d is None:
             print(f"no such stored test: {options.test}")
             return 254
         events, metrics = jstore.load_telemetry(d)
@@ -297,6 +283,61 @@ def telemetry_cmd() -> dict:
         return 0
 
     return {"telemetry": {"parser_fn": build, "run": run}}
+
+
+def _resolve_stored_run(options):
+    """Shared run-dir resolution for artifact subcommands (telemetry,
+    trace): a literal directory, a test name under the store base, or
+    'latest'."""
+    from pathlib import Path
+
+    from . import store as jstore
+
+    base = Path(options.store) if options.store else jstore.BASE
+    d = Path(options.test)
+    if not d.is_dir():
+        d = base / options.test / options.timestamp
+    if options.test == "latest" and not d.is_dir():
+        d = base / "latest"
+    return d if d.is_dir() else None
+
+
+def _store_run_opts(p: argparse.ArgumentParser) -> argparse.ArgumentParser:
+    p.add_argument("test", nargs="?", default="latest",
+                   help="A store directory, or a test name "
+                        "(resolved under the store base).")
+    p.add_argument("--timestamp", default="latest",
+                   help="Which run of the named test.")
+    p.add_argument("--store", default=None,
+                   help="Store base directory (default ./store).")
+    return p
+
+
+def trace_cmd() -> dict:
+    """A 'trace' subcommand: exports a stored run as Chrome-trace JSON
+    (trace.json) openable in ui.perfetto.dev — telemetry spans, op
+    lifetimes (one track per process), and nemesis windows on one
+    timeline (reports/trace.py, doc/observability.md)."""
+    def build(p):
+        _store_run_opts(p)
+        p.add_argument("-o", "--out", default=None,
+                       help="Output path (default: <run>/trace.json).")
+        return p
+
+    def run(options):
+        from .reports import trace as rtrace
+
+        d = _resolve_stored_run(options)
+        if d is None:
+            print(f"no such stored test: {options.test}")
+            return 254
+        out = rtrace.write_trace(d, options.out)
+        print(f"wrote {out}")
+        print("open it at https://ui.perfetto.dev "
+              "(or chrome://tracing)")
+        return 0
+
+    return {"trace": {"parser_fn": build, "run": run}}
 
 
 def serve_cmd() -> dict:

@@ -16,15 +16,18 @@ from __future__ import annotations
 import datetime
 import logging
 import threading
+from pathlib import Path
 from typing import Any
 
 from . import client as jclient
 from . import control
 from . import db as jdb
 from . import interpreter
+from . import monitor as jmonitor
 from . import nemesis as jnemesis
 from . import telemetry
 from . import util
+from . import watchdog as jwatchdog
 from .history import History
 
 logger = logging.getLogger(__name__)
@@ -206,6 +209,13 @@ def analyze(test: dict, store_ctx=None) -> dict:
     # (and therefore results.json) next to the verdict they explain
     if isinstance(test.get("results"), dict):
         test["results"]["telemetry"] = telemetry.get().summary()
+        # the online watchdog's violations ride alongside too —
+        # informational only, never folded into the checkers' valid?
+        wd = test.get("watchdog")
+        if wd is not None and hasattr(wd, "results"):
+            test["results"]["watchdog"] = wd.results()
+            if test.get("aborted"):
+                test["results"]["watchdog"]["aborted"] = test["aborted"]
     logger.info("Analysis complete")
     return test
 
@@ -248,6 +258,17 @@ def run(test: dict) -> dict:
         # times); nothing in analysis reads the ambient origin itself.
         with util.with_relative_time():
             telemetry.reset()
+            # the live monitor + online watchdog span the whole run:
+            # the sampler sees setup, the case, AND analysis (device
+            # occupancy gauges appear mid-analyze), streaming points
+            # into timeseries.jsonl that web.py's /live/ page tails
+            mon = jmonitor.Monitor(test)
+            test["monitor"] = mon
+            wd = jwatchdog.from_test(test)
+            if wd is not None:
+                test["watchdog"] = wd
+            mon.start(Path(test["store_dir"]) / jmonitor.TIMESERIES_FILE
+                      if test.get("store_dir") else None)
             try:
                 with telemetry.span("run", test=test.get("name")):
                     test = control.open_sessions(test)
@@ -274,9 +295,17 @@ def run(test: dict) -> dict:
                         control.close_sessions(test)
 
                 test = analyze(test, store_ctx)
+                # final monitor point BEFORE results.json: /live/
+                # tailers treat results.json as the end-of-run marker
+                # and must not miss the last sample
+                mon.stop()
                 if store_ctx:
                     store_ctx.save_results(test)
             finally:
+                try:
+                    mon.stop()
+                except Exception:  # noqa: BLE001 — best-effort
+                    logger.exception("stopping monitor failed")
                 # even a crashed run leaves its trace behind
                 if store_ctx and test.get("store_dir"):
                     try:

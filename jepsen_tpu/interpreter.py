@@ -204,6 +204,15 @@ def run(test: dict) -> dict:
     test = dict(test)
     test.pop("generator", None)
 
+    # live-observability hooks (both optional): the monitor samples
+    # rates/in-flight/latencies, the watchdog checks safety online.
+    # Their calls are a few dict updates each — the throughput-floor
+    # test runs with both enabled to police this path.
+    mon = test.get("monitor")
+    wd = test.get("watchdog")
+    if wd is not None and not hasattr(wd, "observe"):
+        wd = None  # an unbuilt spec (core.run builds the object)
+
     op_index = 0
     outstanding = 0
     poll_timeout_us = 0
@@ -211,6 +220,17 @@ def run(test: dict) -> dict:
     # hot loop (same rule as the worker threads)
     dispatched = 0
     stalls = 0
+
+    def finish():
+        """Drains workers, closes the writer, reads the history back."""
+        for q in invocations.values():
+            q.put(Op(type="exit"))
+        for w in workers:
+            w["thread"].join()
+        writer.close()
+        test["history"] = writer.read_back()
+        return test
+
     try:
         while True:
             op2 = None
@@ -238,6 +258,18 @@ def run(test: dict) -> dict:
                 if goes_in_history(op2):
                     writer.append(op2)
                     op_index += 1
+                    if mon is not None:
+                        mon.on_complete(op2, thread, now)
+                    if wd is not None:
+                        wd.observe(op2)
+                        if wd.tripped and wd.early_abort:
+                            # safety already lost: stop generating,
+                            # keep what we have (core.analyze still
+                            # runs the full checkers over it)
+                            logger.warning(
+                                "watchdog tripped; aborting run early")
+                            test["aborted"] = "watchdog"
+                            return finish()
                 outstanding -= 1
                 poll_timeout_us = 0
                 continue
@@ -251,19 +283,15 @@ def run(test: dict) -> dict:
                     poll_timeout_us = MAX_PENDING_INTERVAL_US
                     continue
                 # Done: drain workers, close writer, read history back.
-                for q in invocations.values():
-                    q.put(Op(type="exit"))
-                for w in workers:
-                    w["thread"].join()
-                writer.close()
-                test["history"] = writer.read_back()
-                return test
+                return finish()
 
             op_, g2 = res
             if op_ is gen.PENDING:
                 # Keep the pre-call generator state, like the reference
                 # (interpreter.clj:290-291).
                 stalls += 1
+                if mon is not None:
+                    mon.on_stall()
                 poll_timeout_us = MAX_PENDING_INTERVAL_US
                 continue
 
@@ -280,6 +308,10 @@ def run(test: dict) -> dict:
             if goes_in_history(op_):
                 writer.append(op_)
                 op_index += 1
+                if mon is not None:
+                    mon.on_dispatch(op_, thread, now)
+                if wd is not None:
+                    wd.observe(op_)
             invocations[thread].put(op_)
             dispatched += 1
             ctx = ctx.busy_thread(op_.time, thread)

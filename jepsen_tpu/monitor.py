@@ -1,0 +1,342 @@
+"""Live run monitor: streaming time-series for an in-progress test.
+
+PR 2's telemetry layer made runs explainable after the fact; this
+module makes them observable *while they execute* — the gap SURVEY §5
+notes between Jepsen's post-hoc perf plots and a serving stack's live
+dashboards. A background sampler thread snapshots the run's vitals on
+a fixed cadence and appends one JSON point per tick to a
+`timeseries.jsonl` artifact next to `telemetry.jsonl`:
+
+  - ops/s and generator-stall rate (deltas between ticks)
+  - in-flight ops per worker thread, with their current ages
+  - streaming latency quantiles from a mergeable log-bucket histogram
+    (LogHistogram — constant memory, merge-associative across workers)
+  - the active nemesis set (tracked from nemesis op completions)
+  - wgl/elle/scc kernel gauges from the telemetry recorder (so device
+    occupancy is visible mid-analysis, not only at exit)
+  - watchdog violation counts and open telemetry spans
+
+The interpreter feeds the monitor from its main loop (on_dispatch /
+on_complete / on_stall); all hooks are a few dict updates under one
+uncontended lock, cheap enough that the interpreter throughput-floor
+test passes with the monitor enabled (bench.py records the overhead
+delta as a BENCH line).
+
+Because points are appended and flushed incrementally, a *different
+process* (web.py's `/live/` SSE endpoint) can tail the file and stream
+the run live; read_points() tolerates a torn trailing line the same
+way telemetry.read_events does.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import math
+import threading
+from pathlib import Path
+from typing import Any, Callable, Iterator
+
+from . import telemetry, util
+
+logger = logging.getLogger(__name__)
+
+TIMESERIES_FILE = "timeseries.jsonl"
+
+# Gauge prefixes worth streaming live (device-kernel health).
+_LIVE_GAUGE_PREFIXES = ("wgl.", "elle.", "scc.")
+
+
+# ---------------------------------------------------------------------------
+# Streaming histogram
+# ---------------------------------------------------------------------------
+
+class LogHistogram:
+    """A log-bucketed streaming histogram: constant memory, mergeable.
+
+    Bucket b covers [GROWTH**b, GROWTH**(b+1)); GROWTH = 2**(1/8) puts
+    every estimate within ~9% of the true value (one bucket). merge()
+    is a counter add, so per-worker histograms combine associatively
+    and commutatively — the property the live sampler leans on and the
+    test suite checks against numpy.quantile.
+    """
+
+    GROWTH = 2 ** 0.125
+    _LOG_G = math.log(GROWTH)
+
+    def __init__(self):
+        self.counts: dict[int, int] = {}
+        self.zeros = 0  # values <= 0 (clock tie or skew); rank 0
+        self.n = 0
+
+    @classmethod
+    def bucket_of(cls, value: float) -> int:
+        return int(math.floor(math.log(value) / cls._LOG_G))
+
+    def add(self, value: float, n: int = 1) -> None:
+        if value <= 0:
+            self.zeros += n
+        else:
+            b = self.bucket_of(value)
+            self.counts[b] = self.counts.get(b, 0) + n
+        self.n += n
+
+    def merge(self, other: "LogHistogram") -> "LogHistogram":
+        """A new histogram holding both datasets."""
+        out = LogHistogram()
+        for src in (self, other):
+            for b, c in src.counts.items():
+                out.counts[b] = out.counts.get(b, 0) + c
+            out.zeros += src.zeros
+            out.n += src.n
+        return out
+
+    def quantile(self, q: float) -> float | None:
+        """Value at quantile q — the geometric midpoint of the bucket
+        holding the rank-q sample; None on an empty histogram."""
+        if self.n == 0:
+            return None
+        rank = min(self.n - 1, int(math.floor(self.n * q)))
+        if rank < self.zeros:
+            return 0.0
+        seen = self.zeros
+        for b in sorted(self.counts):
+            seen += self.counts[b]
+            if rank < seen:
+                return self.GROWTH ** (b + 0.5)
+        return self.GROWTH ** (max(self.counts) + 0.5)
+
+    def quantiles(self, qs) -> dict:
+        return {q: self.quantile(q) for q in qs}
+
+    def to_dict(self) -> dict:
+        return {"n": self.n, "zeros": self.zeros,
+                "counts": {str(b): c for b, c in self.counts.items()}}
+
+
+# ---------------------------------------------------------------------------
+# Nemesis activity tracking
+# ---------------------------------------------------------------------------
+
+def _nemesis_specs(test) -> list[dict]:
+    """The test's nemesis activity specs, normalized by the single
+    authority (reports/perf._nemesis_specs — what the graphs shade),
+    with the monitor's defaults: unnamed specs display as 'nemesis'
+    and no specs at all means the plain start/stop pair."""
+    from .reports.perf import _nemesis_specs as perf_specs
+
+    out = [{"name": s.get("name") or "nemesis",
+            "start": s["start"], "stop": s["stop"]}
+           for s in perf_specs(test or {})]
+    return out or [{"name": "nemesis", "start": {"start"},
+                    "stop": {"stop"}}]
+
+
+# ---------------------------------------------------------------------------
+# The monitor
+# ---------------------------------------------------------------------------
+
+class Monitor:
+    """Collects per-op signals from the interpreter and samples them
+    periodically into a time-series.
+
+    Lifecycle (driven by core.run): Monitor(test) -> start(path) ->
+    [interpreter feeds hooks] -> stop(). Tests may also drive hooks and
+    sample() directly, without the thread.
+    """
+
+    DEFAULT_INTERVAL_S = 1.0
+
+    def __init__(self, test: dict | None = None,
+                 interval_s: float | None = None):
+        test = test or {}
+        self.interval_s = float(
+            interval_s if interval_s is not None
+            else test.get("monitor_interval_s", self.DEFAULT_INTERVAL_S))
+        self._lock = threading.RLock()
+        self._hist = LogHistogram()
+        self._completed = 0
+        self._dispatched = 0
+        self._stalls = 0
+        self._inflight: dict[Any, int] = {}     # thread -> invoke t (ns)
+        self._nemesis_specs = _nemesis_specs(test)
+        self._nemesis_active: set = set()
+        self._probe_gauges: dict[str, Any] = {}
+        self._probes: list[Callable] = [
+            factory() for factory in (test.get("monitor_probes") or [])]
+        self._points: list[dict] = []
+        self._out = None
+        self._stop = threading.Event()
+        self._stopped = False
+        self._thread: threading.Thread | None = None
+        # previous tick, for rate deltas
+        self._last_t: int | None = None
+        self._last_completed = 0
+        self._last_stalls = 0
+
+    # -- interpreter hooks (main-loop thread) ------------------------------
+
+    def on_dispatch(self, op, thread, now: int) -> None:
+        with self._lock:
+            if op.process != "nemesis":
+                self._dispatched += 1
+            # in-flight tracks every worker (a stuck fault activation
+            # is worth seeing), but only client ops count as ops
+            self._inflight[thread] = now
+
+    def on_complete(self, op, thread, now: int) -> None:
+        with self._lock:
+            t0 = self._inflight.pop(thread, None)
+            if op.process == "nemesis":
+                # fault activations track as nemesis state, never as
+                # client latency/throughput — a multi-second partition
+                # start would otherwise dominate the p99
+                if op.type == "info":
+                    for spec in self._nemesis_specs:
+                        if op.f in spec["start"]:
+                            self._nemesis_active.add(spec["name"])
+                        elif op.f in spec["stop"]:
+                            self._nemesis_active.discard(spec["name"])
+            else:
+                if t0 is not None:
+                    self._hist.add(now - t0)
+                self._completed += 1
+            for probe in self._probes:
+                try:
+                    probe(op, self)
+                except Exception:  # noqa: BLE001 — probes are best-effort
+                    logger.exception("monitor probe failed")
+
+    def on_stall(self) -> None:
+        with self._lock:
+            self._stalls += 1
+
+    def probe_gauge(self, name: str, value) -> None:
+        """Record a workload-specific live gauge (e.g. kafka consumer
+        lag); included in every subsequent sample point."""
+        with self._lock:
+            self._probe_gauges[name] = value
+
+    # -- sampling ----------------------------------------------------------
+
+    def histogram(self) -> LogHistogram:
+        """A snapshot copy of the cumulative latency histogram."""
+        with self._lock:
+            return LogHistogram().merge(self._hist)
+
+    def sample(self) -> dict:
+        """One time-series point. Rates are deltas since the previous
+        sample; quantiles are cumulative (the histogram streams)."""
+        now = util.relative_time_nanos()
+        tel = telemetry.get()
+        with self._lock:
+            dt_s = ((now - self._last_t) / 1e9
+                    if self._last_t is not None else None)
+            d_completed = self._completed - self._last_completed
+            d_stalls = self._stalls - self._last_stalls
+            self._last_t = now
+            self._last_completed = self._completed
+            self._last_stalls = self._stalls
+            qs = self._hist.quantiles((0.5, 0.95, 0.99))
+            point = {
+                "t": now,
+                "ops_s": (round(d_completed / dt_s, 2)
+                          if dt_s else None),
+                "stall_rate": (round(d_stalls / dt_s, 2)
+                               if dt_s else None),
+                "completed": self._completed,
+                "dispatched": self._dispatched,
+                "inflight": {util.name_str(th): now - t0
+                             for th, t0 in self._inflight.items()},
+                "latency_ms": {f"p{int(q * 100)}":
+                               (round(v / 1e6, 3) if v is not None
+                                else None)
+                               for q, v in qs.items()},
+                "nemesis": sorted(self._nemesis_active),
+            }
+            if self._probe_gauges:
+                point["probes"] = dict(self._probe_gauges)
+        gauges = {k: v for k, v in tel.gauges().items()
+                  if k.startswith(_LIVE_GAUGE_PREFIXES)}
+        if gauges:
+            point["gauges"] = gauges
+        wd = tel.counters().get("watchdog.violations", 0)
+        if wd:
+            point["watchdog"] = wd
+        open_spans = [s.get("name") for s in tel.open_spans()]
+        if open_spans:
+            point["open_spans"] = open_spans
+        return point
+
+    def _emit(self) -> None:
+        point = self.sample()
+        with self._lock:
+            self._points.append(point)
+            if self._out is not None:
+                try:
+                    self._out.write(json.dumps(point, default=repr))
+                    self._out.write("\n")
+                    self._out.flush()  # live tailers read mid-run
+                except OSError:
+                    logger.exception("monitor point write failed")
+                    self._out = None
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self, out_path=None) -> "Monitor":
+        if out_path is not None:
+            try:
+                p = Path(out_path)
+                p.parent.mkdir(parents=True, exist_ok=True)
+                self._out = open(p, "w")
+            except OSError:  # observability must never sink the run;
+                logger.exception("monitor artifact unavailable")
+                self._out = None  # points still accumulate in memory
+        self._stop.clear()
+
+        def run():
+            while not self._stop.wait(self.interval_s):
+                try:
+                    self._emit()
+                except Exception:  # noqa: BLE001 — sampler must not die
+                    logger.exception("monitor sample failed")
+
+        self._thread = threading.Thread(
+            target=run, name="jepsen-monitor", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        """Stops the sampler, emitting one final point so short runs
+        always leave at least one behind. Idempotent: core.run stops
+        the monitor before publishing results.json (so /live/ tailers
+        see the final point before the end-of-run marker) and again in
+        its crash-tolerant finally block."""
+        if self._stopped:
+            return
+        self._stopped = True
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+        try:
+            self._emit()
+        finally:
+            if self._out is not None:
+                self._out.close()
+                self._out = None
+
+    def points(self) -> list[dict]:
+        with self._lock:
+            return list(self._points)
+
+
+# ---------------------------------------------------------------------------
+# Reading stored artifacts
+# ---------------------------------------------------------------------------
+
+def read_points(path) -> Iterator[dict]:
+    """Points from a timeseries.jsonl; a torn trailing line (the
+    sampler died, or the run is still writing) is dropped rather than
+    raised (telemetry.read_jsonl, the shared parser)."""
+    return telemetry.read_jsonl(path)

@@ -235,6 +235,44 @@ def rate_preview(test, history: History, opts=None) -> dict:
     return {"valid?": True, "file": path}
 
 
+def monitor_preview(test, history: History, opts=None) -> dict:
+    """The live monitor's time-series as a post-hoc plot: throughput
+    (ops/s) on the left axis, in-flight op count on the right, with
+    the same nemesis shading as the latency/rate graphs so fault
+    windows line up across all of them. Writes monitor.png. Reads the
+    points the sampler streamed (timeseries.jsonl) — the run's live
+    view, preserved."""
+    from .. import store as jstore
+
+    d = test.get("store_dir")
+    if not d:
+        return {"valid?": True}
+    points = jstore.load_timeseries(d)
+    series = [(util.nanos_to_secs(p["t"]), p.get("ops_s"),
+               len(p.get("inflight") or {}))
+              for p in points if "t" in p]
+    series = [(t, r, infl) for t, r, infl in series if r is not None]
+    if not series:
+        return {"valid?": True}
+    plt, fig, ax = _figure()
+    ax.set_ylabel("Throughput (ops/s)")
+    ax.set_title(f"{test.get('name') or 'test'} live monitor")
+    ax.plot([t for t, _, _ in series], [r for _, r, _ in series],
+            marker="o", ms=3, lw=1.2, color=TYPE_COLORS["ok"],
+            label="ops/s", zorder=2)
+    ax2 = ax.twinx()
+    ax2.set_ylabel("In-flight ops")
+    ax2.step([t for t, _, _ in series], [i for _, _, i in series],
+             where="post", lw=1.0, color=TYPE_COLORS["info"],
+             alpha=0.8, label="in-flight", zorder=2)
+    _shade_nemeses(ax, test, history)
+    h1, l1 = ax.get_legend_handles_labels()
+    h2, l2 = ax2.get_legend_handles_labels()
+    ax.legend(h1 + h2, l1 + l2, loc="upper right", fontsize=8)
+    path = _save(plt, fig, test, opts, "monitor.png")
+    return {"valid?": True, "file": path, "points": len(series)}
+
+
 def _plottable(test) -> bool:
     """Plots need a store directory to land in."""
     return bool(test.get("store_dir") or test.get("name"))
@@ -267,6 +305,23 @@ def rate_graph(graph_opts=None):
             return {"valid?": True, "skipped": "no store directory"}
         o = {**(graph_opts or {}), **(opts or {})}
         r = rate_preview(test, history, o)
+        return {"valid?": True,
+                "files": [p for p in [r.get("file")] if p]}
+
+    return _Fn(run)
+
+
+def monitor_graph(graph_opts=None):
+    """Checker rendering the live-monitor throughput/in-flight plot
+    (no reference analog — the series only exists because the monitor
+    sampled it)."""
+    from ..checker import _Fn
+
+    def run(test, history, opts):
+        if not _plottable(test):
+            return {"valid?": True, "skipped": "no store directory"}
+        o = {**(graph_opts or {}), **(opts or {})}
+        r = monitor_preview(test, history, o)
         return {"valid?": True,
                 "files": [p for p in [r.get("file")] if p]}
 

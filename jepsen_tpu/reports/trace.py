@@ -1,0 +1,193 @@
+"""Chrome-trace / Perfetto export for stored runs.
+
+Converts a run's three time-aligned records — telemetry spans, the
+history's op invoke→complete lifetimes, and nemesis activation
+windows — into one Chrome Trace Event Format JSON (`trace.json`)
+openable directly in https://ui.perfetto.dev (or chrome://tracing).
+Everything shares the test's linear clock (util.relative_time_nanos),
+so a kernel launch, the client op it was checking, and the fault
+window it raced all line up on one timeline.
+
+Track layout (pid/tid are synthetic; names ride in `M` metadata
+events, per the trace-event spec):
+
+  harness  one thread-track per recorder thread, nesting spans as the
+           usual flame layout (`X` complete events)
+  clients  one track per process: each op is an `X` slice from its
+           invocation to its completion, colored by completion type
+  nemesis  one track per nemesis spec, a slice per activation window
+
+CLI: `python -m jepsen_tpu trace <run>` writes `trace.json` into the
+run's store directory (see doc/observability.md for the walkthrough).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from pathlib import Path
+
+from .. import util
+from ..history import History, is_info, is_invoke, is_ok
+
+logger = logging.getLogger(__name__)
+
+TRACE_JSON = "trace.json"
+
+# Perfetto/catapult reserved color names, keyed by completion type.
+_CNAME = {"ok": "good", "info": "bad", "fail": "terrible"}
+
+_PID_HARNESS = 1
+_PID_CLIENTS = 2
+_PID_NEMESIS = 3
+
+
+def _us(ns: int) -> float:
+    """Trace-event timestamps are microseconds."""
+    return ns / 1e3
+
+
+class _Tids:
+    """Allocates stable integer tids per track name, emitting the
+    thread_name metadata event on first use."""
+
+    def __init__(self, events: list, pid: int, sort_index: int = 0):
+        self.events = events
+        self.pid = pid
+        self.by_name: dict = {}
+        self.events.append({"ph": "M", "name": "process_sort_index",
+                            "pid": pid, "tid": 0,
+                            "args": {"sort_index": sort_index}})
+
+    def tid(self, name: str) -> int:
+        t = self.by_name.get(name)
+        if t is None:
+            t = self.by_name[name] = len(self.by_name) + 1
+            self.events.append({"ph": "M", "name": "thread_name",
+                                "pid": self.pid, "tid": t,
+                                "args": {"name": str(name)}})
+        return t
+
+
+def _process_meta(events: list, pid: int, name: str) -> None:
+    events.append({"ph": "M", "name": "process_name", "pid": pid,
+                   "tid": 0, "args": {"name": name}})
+
+
+def _span_events(events: list, spans) -> int:
+    """Telemetry spans as one flame-track per recorder thread."""
+    _process_meta(events, _PID_HARNESS, "harness")
+    tids = _Tids(events, _PID_HARNESS, sort_index=0)
+    n = 0
+    for s in spans:
+        if "t0" not in s or "t1" not in s:
+            continue
+        ev = {"ph": "X", "cat": "span",
+              "name": str(s.get("name", "?")),
+              "pid": _PID_HARNESS,
+              "tid": tids.tid(s.get("thread") or "main"),
+              "ts": _us(s["t0"]),
+              "dur": max(_us(s["t1"] - s["t0"]), 0.001)}
+        if s.get("attrs"):
+            ev["args"] = {k: repr(v) for k, v in s["attrs"].items()}
+        events.append(ev)
+        n += 1
+    return n
+
+
+def _op_events(events: list, history) -> int:
+    """Op lifetimes: one track per process, one slice per
+    invoke→complete pair. Uncompleted invokes extend to history end
+    (the same convention the timeline report uses)."""
+    _process_meta(events, _PID_CLIENTS, "clients")
+    tids = _Tids(events, _PID_CLIENTS, sort_index=1)
+    if not isinstance(history, History):
+        history = History(history)
+    tmax = history[-1].time if len(history) else 0
+    n = 0
+    for op in history:
+        if not is_invoke(op):
+            continue
+        comp = history.completion(op)
+        t1 = comp.time if comp is not None else tmax
+        ctype = ("info" if comp is None or is_info(comp)
+                 else "ok" if is_ok(comp) else "fail")
+        ev = {"ph": "X", "cat": "op",
+              "name": str(op.f),
+              "pid": _PID_CLIENTS,
+              "tid": tids.tid(util.name_str(op.process)),
+              "ts": _us(op.time),
+              "dur": max(_us(t1 - op.time), 0.001),
+              "cname": _CNAME[ctype],
+              "args": {"type": ctype, "process": str(op.process),
+                       "value": repr(op.value)}}
+        if comp is not None and comp.value != op.value:
+            ev["args"]["result"] = repr(comp.value)
+        events.append(ev)
+        n += 1
+    return n
+
+
+def _nemesis_events(events: list, test, history) -> int:
+    """Fault-activation windows, one track per nemesis spec — the same
+    intervals reports/perf.py shades."""
+    from .perf import _nemesis_specs
+
+    if not isinstance(history, History):
+        history = History(history)
+    specs = _nemesis_specs(test or {}) or [
+        {"name": "nemesis", "start": {"start"}, "stop": {"stop"}}]
+    _process_meta(events, _PID_NEMESIS, "nemesis")
+    tids = _Tids(events, _PID_NEMESIS, sort_index=2)
+    tmax = history[-1].time if len(history) else 0
+    n = 0
+    for spec in specs:
+        name = spec.get("name") or "nemesis"
+        ints = util.nemesis_intervals(
+            history, [{"start": spec["start"], "stop": spec["stop"]}])
+        for start, stop in ints:
+            t1 = stop.time if stop is not None else tmax
+            events.append({
+                "ph": "X", "cat": "nemesis",
+                "name": str(name),
+                "pid": _PID_NEMESIS, "tid": tids.tid(str(name)),
+                "ts": _us(start.time),
+                "dur": max(_us(t1 - start.time), 0.001),
+                "cname": "terrible",
+                "args": {"start": str(start.f),
+                         "stop": str(stop.f) if stop else "(open)"}})
+            n += 1
+    return n
+
+
+def chrome_trace(test: dict | None, history, spans) -> dict:
+    """The complete trace document for a run. `test` may be the loaded
+    test.json dict (for nemesis plot specs), `history` a History or op
+    list, `spans` telemetry span records."""
+    events: list[dict] = []
+    n_spans = _span_events(events, spans or [])
+    n_ops = _op_events(events, history if history is not None else [])
+    n_nem = _nemesis_events(events, test, history
+                            if history is not None else [])
+    logger.info("trace: %d spans, %d ops, %d nemesis windows",
+                n_spans, n_ops, n_nem)
+    return {"traceEvents": events,
+            "displayTimeUnit": "ms",
+            "otherData": {"source": "jepsen_tpu",
+                          "test": str((test or {}).get("name"))}}
+
+
+def write_trace(run_dir, out_path=None) -> Path:
+    """Loads a stored run and writes its trace.json; returns the
+    path. Works on runs that predate telemetry (spans just come back
+    empty) and on crashed runs (history read is torn-tolerant)."""
+    from .. import store as jstore
+
+    d = Path(run_dir)
+    test = jstore.load(d)
+    events, _metrics = jstore.load_telemetry(d)
+    doc = chrome_trace(test, test.get("history") or [], events)
+    out = Path(out_path) if out_path else d / TRACE_JSON
+    with open(out, "w") as f:
+        json.dump(doc, f)
+    return out

@@ -13,6 +13,9 @@ Layout:
     jepsen.log     per-test log output
     telemetry.jsonl  span trace (jepsen_tpu.telemetry, doc/observability.md)
     metrics.json   aggregated span/counter/gauge metrics
+    timeseries.jsonl  live-monitor sample points (jepsen_tpu.monitor),
+                   appended while the run executes (web.py /live/ tails it)
+    trace.json     Chrome-trace/Perfetto export (reports/trace.py, on demand)
     <node>/...     downloaded node logs (core.snarf_logs)
   store/<name>/latest  -> most recent run   store/latest -> same
   store/current        -> run in progress
@@ -36,7 +39,8 @@ BASE = Path("store")
 
 _SKIP_KEYS = {"history", "results", "barrier", "db", "client", "nemesis",
               "checker", "generator", "os", "remote", "sessions",
-              "history_writer", "store_dir", "_log_handler"}
+              "history_writer", "store_dir", "_log_handler",
+              "monitor", "watchdog", "monitor_probes"}
 
 
 def base_dir(test: dict | None = None) -> Path:
@@ -176,6 +180,16 @@ def load_telemetry(d) -> tuple[list, dict | None]:
     events = list(tel.read_events(d / tel.TRACE_FILE))
     metrics = tel.read_metrics(d / tel.METRICS_FILE)
     return events, metrics
+
+
+def load_timeseries(d) -> list[dict]:
+    """Live-monitor sample points from a stored test dir's
+    timeseries.jsonl; [] when the run predates (or disabled) the
+    monitor."""
+    from .. import monitor as jmonitor
+
+    return list(jmonitor.read_points(
+        Path(d) / jmonitor.TIMESERIES_FILE))
 
 
 def load(name_or_dir, timestamp: str = "latest",

@@ -65,6 +65,7 @@ class Telemetry:
         self._lock = threading.Lock()
         self._local = threading.local()
         self._spans: list[dict] = []
+        self._open: dict[int, dict] = {}
         self._counters: dict[str, int] = {}
         self._gauges: dict[str, Any] = {}
         self._next_id = 0
@@ -100,12 +101,19 @@ class Telemetry:
         if attrs:
             rec["attrs"] = attrs
         stack.append(rec)
+        with self._lock:
+            # shared open-span registry (the thread-local stacks can't
+            # be enumerated across threads): the live monitor samples
+            # it to stream what the run is doing *right now*
+            if self._epoch == epoch0:
+                self._open[sid] = rec
         try:
             yield rec
         finally:
             rec["t1"] = util.relative_time_nanos()
             stack.pop()
             with self._lock:
+                self._open.pop(sid, None)
                 # a straggler thread completing a span after reset()
                 # must not leak it into the next run's trace: its id
                 # would collide with the new run's ids and its clock
@@ -158,6 +166,13 @@ class Telemetry:
         """Completed spans, append order."""
         with self._lock:
             return list(self._spans)
+
+    def open_spans(self) -> list[dict]:
+        """Snapshot copies of currently-open spans (any thread), start
+        order — what the process is doing right now."""
+        with self._lock:
+            return [dict(r) for r in sorted(self._open.values(),
+                                            key=lambda r: r["id"])]
 
     def counters(self) -> dict:
         with self._lock:
@@ -212,6 +227,7 @@ class Telemetry:
     def reset(self) -> None:
         with self._lock:
             self._spans = []
+            self._open = {}
             self._counters = {}
             self._gauges = {}
             self._next_id = 0
@@ -276,9 +292,11 @@ def save(directory) -> tuple[Path, Path]:
 # Reading stored artifacts
 # ---------------------------------------------------------------------------
 
-def read_events(path) -> Iterator[dict]:
-    """Spans from a telemetry.jsonl; a torn/corrupt trailing line (the
-    writer died mid-write) is dropped rather than raised."""
+def read_jsonl(path) -> Iterator[dict]:
+    """Records from a JSONL artifact; a torn/corrupt trailing line
+    (the writer died — or is still — mid-write) is dropped rather than
+    raised. The shared crash-tolerance contract of telemetry.jsonl and
+    the monitor's timeseries.jsonl."""
     p = Path(path)
     if not p.exists():
         return
@@ -291,6 +309,11 @@ def read_events(path) -> Iterator[dict]:
                 yield json.loads(line)
             except ValueError:
                 return
+
+
+def read_events(path) -> Iterator[dict]:
+    """Spans from a telemetry.jsonl (see read_jsonl)."""
+    return read_jsonl(path)
 
 
 def read_metrics(path) -> dict | None:

@@ -4,6 +4,13 @@ Capability reference: jepsen/src/jepsen/web.clj — home page scanning
 the store with cheap header reads (51-112), per-test file browser with
 a path-traversal guard (288-388), zip download of a test directory
 (340-381), app routes '/' and '/files/' (431-446).
+
+Beyond the reference: a `/telemetry/<run>` span/metrics page and a
+`/live/<run>` dashboard that streams an *in-progress* run over
+Server-Sent Events by tailing the live monitor's timeseries.jsonl
+(jepsen_tpu.monitor flushes each point, so the server — typically a
+separate process from the test — sees them as they land). `/live/`
+with no run path follows the store's `current` symlink.
 """
 
 from __future__ import annotations
@@ -13,14 +20,21 @@ import io
 import json
 import logging
 import threading
+import time
 import zipfile
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from pathlib import Path
-from urllib.parse import unquote
+from urllib.parse import parse_qs, unquote, urlsplit
 
 from . import store as jstore
 
 logger = logging.getLogger(__name__)
+
+# SSE tail tuning: poll cadence for new points, idle heartbeat, and a
+# hard cap so an abandoned client can't pin a thread forever.
+SSE_POLL_S = 0.25
+SSE_HEARTBEAT_S = 10.0
+SSE_MAX_S = 6 * 3600.0
 
 
 def fast_tests(base: Path | None = None) -> list:
@@ -60,6 +74,7 @@ def home_html(base: Path | None = None) -> str:
             f"</a></td>"
             f"<td><a href='/telemetry/{_html.escape(rel)}'>telemetry"
             f"</a></td>"
+            f"<td><a href='/live/{_html.escape(rel)}'>live</a></td>"
             f"<td><a href='/zip/{_html.escape(rel)}'>zip</a></td>"
             f"</tr>")
     return ("<!DOCTYPE html><html><head><meta charset='utf-8'>"
@@ -69,7 +84,7 @@ def home_html(base: Path | None = None) -> str:
             "td, th { padding: 4px 10px; text-align: left }"
             "</style></head><body><h1>Jepsen</h1><table>"
             "<tr><th>Test</th><th>Time</th><th>Valid?</th>"
-            "<th colspan=4>Artifacts</th></tr>"
+            "<th colspan=5>Artifacts</th></tr>"
             + "".join(rows) + "</table></body></html>")
 
 
@@ -80,8 +95,90 @@ def dir_html(rel: str, d: Path) -> str:
         f"<li><a href='/files/{_html.escape(rel)}{_html.escape(e.name)}"
         f"{'/' if e.is_dir() else ''}'>{_html.escape(e.name)}"
         f"{'/' if e.is_dir() else ''}</a></li>" for e in entries)
+    views = ""
+    if (d / "test.json").exists():
+        # a run directory: link its rendered views next to the raw files
+        run_rel = _html.escape(rel.rstrip("/"))
+        views = (f"<p>views: <a href='/telemetry/{run_rel}'>telemetry"
+                 f"</a> · <a href='/live/{run_rel}'>live</a></p>")
     return (f"<!DOCTYPE html><html><body><h1>{_html.escape(rel)}</h1>"
-            f"<ul>{items}</ul></body></html>")
+            f"{views}<ul>{items}</ul></body></html>")
+
+
+def live_html(rel: str) -> str:
+    """The live dashboard: an EventSource over the SSE endpoint,
+    rendering the newest sample point's vitals plus a rolling log.
+    Works for finished runs too (replays the stored series, then
+    gets the end event)."""
+    sse = f"/live/{rel}?events=1" if rel else "/live/?events=1"
+    title = _html.escape(rel or "current run")
+    return ("<!DOCTYPE html><html><head><meta charset='utf-8'>"
+            f"<title>live — {title}</title><style>"
+            "body { font-family: sans-serif; margin: 1.5em } "
+            ".tiles { display: flex; gap: 1em; flex-wrap: wrap } "
+            ".tile { border: 1px solid #ddd; border-radius: 6px; "
+            "padding: .6em 1em; min-width: 7em } "
+            ".tile b { display: block; font-size: 1.6em } "
+            ".tile span { color: #888; font-size: .8em } "
+            "#spans, #nemesis { color: #888 } "
+            "table { border-collapse: collapse; margin-top: 1em } "
+            "td, th { padding: 2px 10px; text-align: right; "
+            "border-bottom: 1px solid #eee; font-size: 13px } "
+            "#state { color: #888 }"
+            "</style></head><body>"
+            f"<h1>live — {title}</h1><p id='state'>connecting…</p>"
+            "<div class='tiles'>"
+            "<div class='tile'><b id='ops'>–</b><span>ops/s</span></div>"
+            "<div class='tile'><b id='p50'>–</b><span>p50 ms</span></div>"
+            "<div class='tile'><b id='p99'>–</b><span>p99 ms</span></div>"
+            "<div class='tile'><b id='inflight'>–</b>"
+            "<span>in flight</span></div>"
+            "<div class='tile'><b id='stalls'>–</b>"
+            "<span>stalls/s</span></div>"
+            "<div class='tile'><b id='watchdog'>0</b>"
+            "<span>watchdog</span></div>"
+            "</div>"
+            "<p>open spans: <span id='spans'>–</span><br>"
+            "nemesis: <span id='nemesis'>–</span></p>"
+            "<table id='log'><tr><th>t (s)</th><th>ops/s</th>"
+            "<th>p50</th><th>p95</th><th>p99</th><th>in&nbsp;flight</th>"
+            "<th>stalls/s</th></tr></table>"
+            "<script>\n"
+            f"var es = new EventSource({json.dumps(sse)});\n"
+            "var n = 0;\n"
+            "function set(id, v) { document.getElementById(id)"
+            ".textContent = (v === null || v === undefined) ? '–' : v; }\n"
+            "es.onopen = function() { set('state', 'streaming'); };\n"
+            "es.addEventListener('end', function() { "
+            "set('state', 'run complete'); es.close(); });\n"
+            "es.onerror = function() { set('state', 'disconnected'); };\n"
+            "es.onmessage = function(m) {\n"
+            "  var p = JSON.parse(m.data);\n"
+            "  var lat = p.latency_ms || {};\n"
+            "  set('ops', p.ops_s); set('p50', lat.p50); "
+            "set('p99', lat.p99);\n"
+            "  set('inflight', Object.keys(p.inflight || {}).length);\n"
+            "  set('stalls', p.stall_rate); "
+            "set('watchdog', p.watchdog || 0);\n"
+            "  set('spans', (p.open_spans || []).join(' › ') || '(none)');"
+            "\n"
+            "  set('nemesis', (p.nemesis || []).join(', ') || '(quiet)');"
+            "\n"
+            "  var tr = document.createElement('tr');\n"
+            "  [ (p.t / 1e9).toFixed(1), p.ops_s, lat.p50, lat.p95, "
+            "lat.p99,\n"
+            "    Object.keys(p.inflight || {}).length, p.stall_rate ]\n"
+            "    .forEach(function(v) { var td = "
+            "document.createElement('td');\n"
+            "      td.textContent = (v === null || v === undefined) "
+            "? '–' : v; tr.appendChild(td); });\n"
+            "  var log = document.getElementById('log');\n"
+            "  log.insertBefore(tr, log.rows[1] || null);\n"
+            "  if (log.rows.length > 31) "
+            "log.deleteRow(log.rows.length - 1);\n"
+            "  n++;\n"
+            "};\n"
+            "</script></body></html>")
 
 
 CONTENT_TYPES = {".html": "text/html", ".json": "application/json",
@@ -113,8 +210,79 @@ class StoreHandler(BaseHTTPRequestHandler):
             return p
         return None
 
+    def _live_dir(self, rel: str) -> Path | None:
+        """The run directory a /live/ path names; an empty rel follows
+        the store's `current` symlink (the run in progress), falling
+        back to `latest`."""
+        if rel:
+            p = self._resolve(rel)
+            return p if p is not None and p.is_dir() else None
+        for link in ("current", "latest"):
+            p = self.base / link
+            if p.is_dir():
+                # pin the real directory: the `current` symlink is
+                # removed when the run finishes, mid-stream
+                return p.resolve()
+        return None
+
+    def _sse_stream(self, d: Path) -> None:
+        """Tails the run's timeseries.jsonl as Server-Sent Events: one
+        `data:` message per sample point, `event: end` once the run
+        has finished and the series is drained. The monitor flushes
+        every point, so an in-progress run streams live even though
+        this server is a different process."""
+        from . import monitor as jmonitor
+
+        self.send_response(200)
+        self.send_header("Content-Type", "text/event-stream")
+        self.send_header("Cache-Control", "no-cache")
+        self.end_headers()
+        ts = d / jmonitor.TIMESERIES_FILE
+        deadline = time.monotonic() + SSE_MAX_S
+        last_beat = time.monotonic()
+        f = None
+        try:
+            while time.monotonic() < deadline:
+                if f is None and ts.exists():
+                    f = open(ts)
+                progressed = False
+                if f is not None:
+                    while True:
+                        pos = f.tell()
+                        line = f.readline()
+                        if not line.endswith("\n"):
+                            # torn tail (sampler mid-write): rewind,
+                            # retry next poll
+                            f.seek(pos)
+                            break
+                        line = line.strip()
+                        if line:
+                            self.wfile.write(
+                                b"data: " + line.encode() + b"\n\n")
+                            progressed = True
+                if progressed:
+                    self.wfile.flush()
+                    last_beat = time.monotonic()
+                # results.json marks the run finished; core.run stops
+                # the monitor (final point flushed) before writing it,
+                # so draining then ending cannot skip the last sample
+                if not progressed and (d / "results.json").exists():
+                    self.wfile.write(b"event: end\ndata: {}\n\n")
+                    self.wfile.flush()
+                    return
+                if time.monotonic() - last_beat > SSE_HEARTBEAT_S:
+                    self.wfile.write(b": ping\n\n")  # keep-alive
+                    self.wfile.flush()
+                    last_beat = time.monotonic()
+                time.sleep(SSE_POLL_S)
+        finally:
+            if f is not None:
+                f.close()
+
     def do_GET(self):  # noqa: N802
-        path = unquote(self.path.split("?", 1)[0])
+        split = urlsplit(self.path)
+        path = unquote(split.path)
+        query = parse_qs(split.query)
         try:
             if path == "/":
                 self._send(200, home_html(self.base).encode())
@@ -145,6 +313,17 @@ class StoreHandler(BaseHTTPRequestHandler):
                     else:
                         self._send(200, rtel.telemetry_html(
                             rel, events, metrics).encode())
+            elif path == "/live" or path.startswith("/live/"):
+                rel = path[len("/live/"):].rstrip("/") \
+                    if path.startswith("/live/") else ""
+                d = self._live_dir(rel)
+                if d is None:
+                    self._send(404, b"no such run (and no run in "
+                               b"progress)", "text/plain")
+                elif query.get("events"):
+                    self._sse_stream(d)
+                else:
+                    self._send(200, live_html(rel).encode())
             elif path.startswith("/zip/"):
                 rel = path[len("/zip/"):].rstrip("/")
                 p = self._resolve(rel)
@@ -160,8 +339,8 @@ class StoreHandler(BaseHTTPRequestHandler):
                     self._send(200, buf.getvalue(), "application/zip")
             else:
                 self._send(404, b"not found", "text/plain")
-        except BrokenPipeError:
-            pass
+        except (BrokenPipeError, ConnectionResetError):
+            pass  # client went away (normal for abandoned SSE tails)
         except Exception:  # noqa: BLE001
             logger.exception("web error")
             self._send(500, b"internal error", "text/plain")

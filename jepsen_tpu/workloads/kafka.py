@@ -356,6 +356,45 @@ class Analysis:
                                      "op": t.op})
                         store[key] = r
 
+    # -- realtime lag ------------------------------------------------------
+
+    def realtime_lag(self) -> dict:
+        """How far each consumer ran behind the log, in wall time
+        (kafka.clj realtime-lag, 1359): at every ok poll completion,
+        the lag is the age of the oldest acknowledged-but-not-yet-
+        polled message on that consumer's keys — 0 when caught up.
+        Returns the stats tail: worst observation plus per-(process,
+        key) final lags. Shares _LagTracker with the live lag_probe so
+        post-hoc and streamed numbers can't diverge."""
+        tracker = _LagTracker()
+        worst = None
+        final: dict = {}                  # (process, k) -> last lag ms
+        for t in self.stream:             # completion order
+            if t.op.f not in _TXN_FS:
+                continue
+            if t.type == h.OK or (t.type == h.INFO
+                                  and t.op.f != "poll"):
+                for m in _mop_sends(t.mops):
+                    tracker.ack_send(m, t.op.time)
+            if t.type != h.OK:
+                continue
+            for m in _mop_polls(t.mops):
+                if not (len(m) > 1 and isinstance(m[1], dict)):
+                    continue
+                for k, lag_ms in tracker.poll_lags(
+                        t.process, m[1], t.op.time):
+                    final[(t.process, k)] = lag_ms
+                    if worst is None or lag_ms > worst["lag-ms"]:
+                        worst = {"process": t.process, "key": k,
+                                 "time": t.op.time, "lag-ms": lag_ms}
+        return {
+            "worst-realtime-lag": worst or {"lag-ms": 0.0},
+            "max-lag-ms": worst["lag-ms"] if worst else 0.0,
+            "final-lags-ms": {f"{p}:{k}": v
+                              for (p, k), v in sorted(
+                                  final.items(), key=repr)},
+        }
+
     # -- dependency cycles -------------------------------------------------
 
     def _cycles(self):
@@ -451,6 +490,12 @@ def check(hist, opts: dict | None = None) -> dict:
         "bad-error-types": bad,
         "errors": out_errors,
         "unseen": {k: len(v) for k, v in a.unseen.items()},
+        # the stats tail (kafka.clj realtime-lag + unseen recovery):
+        # how far consumers ran behind, and what never surfaced
+        "realtime-lag": dict(
+            a.realtime_lag(),
+            **{"unseen-at-end": {k: len(v) for k, v in sorted(
+                a.unseen.items())}}),
     }
 
 
@@ -466,6 +511,79 @@ def checker(opts: dict | None = None) -> chk.Checker:
         return check(hist, merged)
 
     return _Fn(run)
+
+
+class _LagTracker:
+    """The realtime-lag bookkeeping shared by the post-hoc analysis
+    (Analysis.realtime_lag) and the live monitor probe (lag_probe):
+    acked sends per key, each consumer's highest polled offset, and
+    the age of the oldest acked-but-unpolled message at a poll.
+
+    Memory/scan cost is bounded by pruning acked entries every
+    consumer known to poll a key has passed: a long run holds only the
+    slowest consumer's backlog per key, not every send ever acked.
+    (Tradeoff: a consumer that starts polling a key only late in the
+    run can't be charged for messages pruned before its first poll —
+    the lag it reports from then on is still exact.)"""
+
+    def __init__(self):
+        self.acked: dict = defaultdict(list)  # k -> [(off, ack t ns)]
+        self.hp: dict = defaultdict(dict)     # k -> {process: off}
+
+    def ack_send(self, mop, now: int) -> None:
+        """Records one completed send mop carrying [offset, value]."""
+        v = mop[2]
+        if isinstance(v, list) and len(v) == 2 and v[0] is not None:
+            self.acked[mop[1]].append((v[0], now))
+
+    def poll_lags(self, process, reads: dict, now: int):
+        """Yields (key, lag_ms) for one ok poll's {k: pairs} reads."""
+        for k, pairs in reads.items():
+            frontiers = self.hp[k]
+            seen = frontiers.get(process, -1)
+            for off, _val in pairs:
+                if off is not None and off > seen:
+                    seen = off
+            frontiers[process] = seen
+            acked = self.acked[k]
+            oldest = min((at for off, at in acked if off > seen),
+                         default=None)
+            floor = min(frontiers.values())
+            if floor >= 0:
+                self.acked[k] = [e for e in acked if e[0] > floor]
+            yield k, (round((now - oldest) / 1e6, 3)
+                      if oldest is not None else 0.0)
+
+
+def lag_probe():
+    """A live-monitor probe (jepsen_tpu.monitor probe protocol: a
+    factory returning `probe(op, monitor)`) streaming consumer
+    realtime lag into the run's time-series — the online counterpart
+    of Analysis.realtime_lag, over the same _LagTracker."""
+    tracker = _LagTracker()
+
+    def probe(op, monitor):
+        # ok AND info completions ack their sends (an indeterminate
+        # send that reported offsets still landed — same rule as the
+        # post-hoc path, so live and stored lag numbers agree); polls
+        # only count when ok
+        if op.type not in (h.OK, h.INFO) or op.f not in _TXN_FS \
+                or not isinstance(op.value, list):
+            return
+        max_lag = None
+        for m in op.value:
+            if m[0] == "send":
+                tracker.ack_send(m, op.time)
+            elif (op.type == h.OK and m[0] == "poll" and len(m) > 1
+                    and isinstance(m[1], dict)):
+                for _k, lag in tracker.poll_lags(
+                        op.process, m[1], op.time):
+                    if max_lag is None or lag > max_lag:
+                        max_lag = lag
+        if max_lag is not None:
+            monitor.probe_gauge("kafka.realtime-lag-ms", max_lag)
+
+    return probe
 
 
 class _DrainGen(gen.Generator):
@@ -521,4 +639,7 @@ def workload(opts: dict | None = None) -> dict:
         "final_generator": final,
         "checker": chk.compose({"kafka": checker(o),
                                 "stats": chk.stats()}),
+        # consumer lag streams into timeseries.jsonl while the test
+        # runs (core.run hands these factories to the Monitor)
+        "monitor_probes": [lag_probe],
     }

@@ -1,0 +1,674 @@
+"""Live-monitor subsystem tests: the streaming log-bucket histogram
+(quantile accuracy vs numpy, merge associativity), the sampler's
+time-series artifact, the online safety watchdog (seeded violations,
+verdict non-interference, early abort), the Chrome-trace exporter, the
+/live/ SSE endpoint against an in-progress run, and the hot-loop
+throughput floor with the monitor enabled."""
+
+import json
+import random
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from jepsen_tpu import checker as jchecker
+from jepsen_tpu import cli, client as jclient, core, interpreter
+from jepsen_tpu import generator as gen
+from jepsen_tpu import monitor as jmonitor
+from jepsen_tpu import store as jstore
+from jepsen_tpu import telemetry, testing, util, watchdog
+from jepsen_tpu.history import Op
+from jepsen_tpu.monitor import LogHistogram
+from jepsen_tpu.workloads import register as register_wl
+
+
+# ---------------------------------------------------------------------------
+# LogHistogram
+# ---------------------------------------------------------------------------
+
+class TestLogHistogram:
+    @pytest.mark.parametrize("name,values", [
+        ("uniform", np.random.RandomState(7).uniform(
+            1e3, 1e8, 5000)),
+        ("lognormal", np.exp(np.random.RandomState(7).normal(
+            14, 2, 5000))),
+        # adversarial: huge dynamic range, ties, bucket-edge values
+        ("adversarial", np.array(
+            [1.0] * 500 + [2.0 ** (k / 8) for k in range(0, 400)] * 5
+            + [1e12] * 100 + [3.0] * 1000)),
+    ])
+    def test_quantiles_within_one_bucket_of_numpy(self, name, values):
+        h = LogHistogram()
+        for v in values:
+            h.add(float(v))
+        for q in (0.1, 0.5, 0.9, 0.95, 0.99):
+            est = h.quantile(q)
+            true = float(np.quantile(values, q, method="lower"))
+            # "within one bucket": the estimate's bucket is adjacent
+            # to (or equal to) the true quantile's bucket
+            assert abs(LogHistogram.bucket_of(est)
+                       - LogHistogram.bucket_of(true)) <= 1, \
+                (name, q, est, true)
+
+    def test_merge_associative_and_commutative(self):
+        """Histograms built by concurrent workers must combine to the
+        same result regardless of merge order."""
+        rng = random.Random(3)
+        chunks = [[rng.lognormvariate(12, 3) for _ in range(500)]
+                  for _ in range(4)]
+        hs = []
+        for chunk in chunks:
+            h = LogHistogram()
+            for v in chunk:
+                h.add(v)
+            hs.append(h)
+        left = hs[0].merge(hs[1]).merge(hs[2]).merge(hs[3])
+        right = hs[0].merge(hs[1].merge(hs[2].merge(hs[3])))
+        swapped = hs[3].merge(hs[2]).merge(hs[1].merge(hs[0]))
+        assert left.counts == right.counts == swapped.counts
+        assert left.n == right.n == swapped.n == 2000
+        for q in (0.5, 0.99):
+            assert left.quantile(q) == right.quantile(q) \
+                == swapped.quantile(q)
+        # and the merged histogram equals one built from all the data
+        whole = LogHistogram()
+        for chunk in chunks:
+            for v in chunk:
+                whole.add(v)
+        assert whole.counts == left.counts
+
+    def test_empty_zero_and_edge(self):
+        h = LogHistogram()
+        assert h.quantile(0.5) is None
+        h.add(0)
+        h.add(-5)
+        assert h.quantile(0.5) == 0.0
+        h2 = LogHistogram()
+        h2.add(1e6, n=3)
+        q = h2.quantile(0.5)
+        assert 1e6 / LogHistogram.GROWTH <= q <= 1e6 * LogHistogram.GROWTH
+
+
+# ---------------------------------------------------------------------------
+# Monitor unit behavior
+# ---------------------------------------------------------------------------
+
+class TestMonitor:
+    def test_hooks_and_sample_fields(self):
+        util.init_relative_time()
+        m = jmonitor.Monitor({}, interval_s=99)
+        now = util.relative_time_nanos()
+        inv = Op(type="invoke", process=0, f="w", time=now)
+        m.on_dispatch(inv, 0, now)
+        p = m.sample()
+        assert p["dispatched"] == 1 and p["completed"] == 0
+        assert list(p["inflight"]) == ["0"]
+        m.on_complete(inv.copy(type="ok"), 0, now + 2_000_000)
+        m.on_stall()
+        p2 = m.sample()
+        assert p2["completed"] == 1 and p2["inflight"] == {}
+        assert p2["ops_s"] is not None and p2["stall_rate"] > 0
+        assert p2["latency_ms"]["p50"] == pytest.approx(2.0, rel=0.2)
+
+    def test_nemesis_activity_tracking(self):
+        util.init_relative_time()
+        m = jmonitor.Monitor({}, interval_s=99)
+        t = util.relative_time_nanos()
+        inv = Op(type="invoke", process="nemesis", f="start", time=t)
+        m.on_dispatch(inv, "nemesis", t)
+        start = Op(type="info", process="nemesis", f="start", time=t)
+        m.on_complete(start, "nemesis", t + 5_000_000_000)
+        p = m.sample()
+        assert p["nemesis"] == ["nemesis"]
+        # a 5s fault activation is nemesis state, NOT client latency
+        # or throughput
+        assert p["completed"] == 0 and p["dispatched"] == 0
+        assert p["latency_ms"]["p50"] is None
+        stop = Op(type="info", process="nemesis", f="stop", time=t)
+        m.on_complete(stop, "nemesis", t)
+        assert m.sample()["nemesis"] == []
+
+    def test_probe_gauges_flow_into_points(self):
+        util.init_relative_time()
+        seen = []
+
+        def probe_factory():
+            def probe(op, monitor):
+                seen.append(op.f)
+                monitor.probe_gauge("lag", 42)
+            return probe
+
+        m = jmonitor.Monitor({"monitor_probes": [probe_factory]},
+                             interval_s=99)
+        t = util.relative_time_nanos()
+        m.on_complete(Op(type="ok", process=0, f="poll", time=t), 0, t)
+        assert seen == ["poll"]
+        assert m.sample()["probes"] == {"lag": 42}
+
+    def test_sampler_thread_writes_jsonl(self, tmp_path):
+        util.init_relative_time()
+        m = jmonitor.Monitor({}, interval_s=0.02)
+        out = tmp_path / "timeseries.jsonl"
+        m.start(out)
+        time.sleep(0.1)
+        m.stop()
+        pts = list(jmonitor.read_points(out))
+        assert len(pts) >= 2
+        assert all("t" in p for p in pts)
+        # torn trailing line is dropped, like telemetry.read_events
+        with open(out, "a") as f:
+            f.write('{"t": 12')
+        assert len(list(jmonitor.read_points(out))) == len(pts)
+
+    def test_open_spans_visible_in_sample(self):
+        util.init_relative_time()
+        telemetry.reset()
+        m = jmonitor.Monitor({}, interval_s=99)
+        with telemetry.span("outer"):
+            with telemetry.span("inner"):
+                p = m.sample()
+        assert p["open_spans"] == ["outer", "inner"]
+        assert "open_spans" not in m.sample()
+
+
+# ---------------------------------------------------------------------------
+# Watchdog adapters
+# ---------------------------------------------------------------------------
+
+def _ops(*specs):
+    """Op stream from (type, f, value) tuples."""
+    return [Op(index=i, time=i, type=t, process=0, f=f, value=v)
+            for i, (t, f, v) in enumerate(specs)]
+
+
+class TestWatchdogAdapters:
+    def test_register_impossible_read(self):
+        wd = watchdog.from_test({"watchdog": ["register"]})
+        for op in _ops(("invoke", "write", 1), ("ok", "write", 1),
+                       ("invoke", "read", None), ("ok", "read", 1)):
+            wd.observe(op)
+        assert not wd.tripped
+        wd.observe(Op(index=9, time=9, type="ok", process=0,
+                      f="read", value=777))
+        assert wd.tripped
+        assert wd.violations[0]["type"] == "impossible-read"
+
+    def test_register_independent_tuples_and_cas_from(self):
+        wd = watchdog.from_test({"watchdog": ["register"]})
+        for op in _ops(("invoke", "write", ("k1", 5)),
+                       ("ok", "write", ("k1", 5)),
+                       ("ok", "read", ("k1", 5)),
+                       ("ok", "read", ("k2", None))):
+            wd.observe(op)
+        assert not wd.tripped
+        # a cas claiming to have seen a value nobody attempted on k2
+        wd.observe(Op(index=8, time=8, type="ok", process=0, f="cas",
+                      value=("k2", [123, 5])))
+        assert wd.tripped
+        assert wd.violations[0]["type"] == "impossible-cas-from"
+
+    def test_counter_bounds_and_arming(self):
+        wd = watchdog.from_test({"watchdog": ["counter"]})
+        # unarmed: numeric reads from some other workload are ignored
+        wd.observe(Op(type="ok", process=0, f="read", value=50))
+        assert not wd.tripped
+        for op in _ops(("invoke", "add", 5), ("ok", "add", 5),
+                       ("invoke", "add", -2), ("ok", "add", -2),
+                       ("ok", "read", 3), ("ok", "read", -2),
+                       ("ok", "read", 5)):
+            wd.observe(op)
+        assert not wd.tripped
+        wd.observe(Op(type="ok", process=0, f="read", value=6))
+        assert wd.tripped
+        assert wd.violations[0]["type"] == "counter-out-of-bounds"
+
+    def test_set_dirty_and_phantom_reads(self):
+        wd = watchdog.from_test({"watchdog": ["set"]})
+        wd.observe(Op(type="ok", process=0, f="read", value=[9]))
+        assert not wd.tripped  # unarmed: no adds seen yet
+        for op in _ops(("invoke", "add", 1), ("ok", "add", 1),
+                       ("invoke", "add", 2), ("fail", "add", 2),
+                       ("ok", "read", [1])):
+            wd.observe(op)
+        assert not wd.tripped
+        wd.observe(Op(type="ok", process=0, f="read", value=[1, 2]))
+        assert wd.tripped
+        assert wd.violations[0]["type"] == "dirty-read"
+        wd2 = watchdog.from_test({"watchdog": ["set"]})
+        wd2.observe(Op(type="invoke", process=0, f="add", value=1))
+        wd2.observe(Op(type="ok", process=0, f="read", value=[77]))
+        assert wd2.violations[0]["type"] == "phantom-read"
+
+    def test_set_retry_interleaving_is_not_dirty(self):
+        """A failed add with a retry in flight may legitimately show
+        up in a read (the retry applied server-side before its
+        completion arrived) — flagging it would be unsound."""
+        wd = watchdog.from_test({"watchdog": ["set"]})
+        for op in _ops(("invoke", "add", 5), ("fail", "add", 5),
+                       ("invoke", "add", 5),  # retry outstanding
+                       ("ok", "read", [5])):
+            wd.observe(op)
+        assert not wd.tripped, wd.violations
+        # once the retry also fails, the element's presence IS dirty
+        wd.observe(Op(type="fail", process=0, f="add", value=5))
+        wd.observe(Op(type="ok", process=0, f="read", value=[5]))
+        assert wd.tripped
+        assert wd.violations[0]["type"] == "dirty-read"
+        # an indeterminate (:info) attempt legitimizes forever
+        wd2 = watchdog.from_test({"watchdog": ["set"]})
+        for op in _ops(("invoke", "add", 9), ("info", "add", 9),
+                       ("ok", "read", [9])):
+            wd2.observe(op)
+        assert not wd2.tripped
+
+    def test_no_cross_flagging_with_all_adapters(self):
+        """A register stream through ALL adapters must stay quiet —
+        arming keeps foreign adapters out of ambiguous reads."""
+        wd = watchdog.from_test({"watchdog": True})
+        for op in _ops(("invoke", "write", 3), ("ok", "write", 3),
+                       ("invoke", "read", None), ("ok", "read", 3),
+                       ("invoke", "cas", [3, 1]), ("ok", "cas", [3, 1]),
+                       ("ok", "read", 1)):
+            wd.observe(op)
+        assert not wd.tripped, wd.violations
+
+    def test_from_test_spec_shapes(self):
+        assert watchdog.from_test({}) is None
+        assert watchdog.from_test({"watchdog": False}) is None
+        wd = watchdog.from_test({"watchdog": True})
+        assert {a.name for a in wd.adapters} == {"register", "counter",
+                                                "set"}
+        wd = watchdog.from_test({"watchdog": {"adapters": ["set"],
+                                              "early_abort": True}})
+        assert wd.early_abort and len(wd.adapters) == 1
+        with pytest.raises(ValueError):
+            watchdog.from_test({"watchdog": ["nope"]})
+
+    def test_violation_raises_telemetry_span_and_counter(self):
+        telemetry.reset()
+        wd = watchdog.from_test({"watchdog": ["register"]})
+        wd.observe(Op(index=0, time=0, type="invoke", process=0,
+                      f="write", value=1))
+        wd.observe(Op(index=1, time=1, type="ok", process=0,
+                      f="read", value=2))
+        assert telemetry.get().counters()["watchdog.violations"] == 1
+        names = [e["name"] for e in telemetry.get().events()]
+        assert "watchdog" in names
+
+
+# ---------------------------------------------------------------------------
+# Pipeline: monitor + watchdog through core.run
+# ---------------------------------------------------------------------------
+
+class SeededViolationClient(jclient.Client):
+    """Wraps AtomClient, corrupting the Nth read completion to return
+    a value no write ever attempted — the seeded mid-run violation."""
+
+    def __init__(self, state, bad_at=10):
+        self.inner = testing.AtomClient(state)
+        self.bad_at = bad_at
+        self.reads = [0]
+
+    def open(self, test, node):
+        return self
+
+    def invoke(self, test, op):
+        out = self.inner.invoke(test, op)
+        if op.f == "read" and out.type == "ok":
+            self.reads[0] += 1
+            if self.reads[0] == self.bad_at:
+                return out.copy(value=999_999)
+        return out
+
+
+def _register_test(tmp_path, name, n=60, **kw):
+    state = testing.AtomState()
+    rng = random.Random(7)
+    t = testing.noop_test()
+    t.update(
+        name=name, store_base=str(tmp_path), nodes=["n1", "n2"],
+        concurrency=4, monitor_interval_s=0.02,
+        client=testing.AtomClient(state),
+        checker=jchecker.stats(),
+        generator=gen.clients(gen.limit(
+            n, lambda: register_wl.cas_op_mix(rng, n_values=3))))
+    t.update(kw)
+    return t
+
+
+class TestPipeline:
+    def test_run_writes_timeseries_artifact(self, tmp_path):
+        test = core.run(_register_test(tmp_path, "mon-e2e"))
+        assert test["results"]["valid?"] is True
+        d = jstore.path(test)
+        pts = jstore.load_timeseries(d)
+        assert len(pts) >= 1
+        last = pts[-1]
+        assert last["completed"] == 60 and last["dispatched"] == 60
+        assert last["latency_ms"]["p50"] is not None
+
+    def test_watchdog_flags_seeded_violation_without_changing_verdict(
+            self, tmp_path):
+        state = testing.AtomState()
+        test = _register_test(tmp_path, "wd-e2e", n=80,
+                              watchdog=["register"])
+        test["client"] = SeededViolationClient(state, bad_at=10)
+        test = core.run(test)
+        res = test["results"]
+        # the checkers' verdict is untouched (stats says valid)...
+        assert res["valid?"] is True
+        # ...while the watchdog reports the seeded violation alongside
+        wd = res["watchdog"]
+        assert wd["valid?"] is False and wd["count"] >= 1
+        assert wd["violations"][0]["type"] == "impossible-read"
+        assert wd["violations"][0]["value"] == 999_999
+        assert not test.get("aborted")
+        # full history: nothing was cut short
+        assert len(test["history"]) == 160
+        # the violation is in the saved telemetry + final point
+        assert test["results"]["telemetry"]["counters"][
+            "watchdog.violations"] >= 1
+
+    def test_watchdog_early_abort_stops_the_run(self, tmp_path):
+        state = testing.AtomState()
+        test = _register_test(tmp_path, "wd-abort", n=2000,
+                              watchdog=["register"],
+                              early_abort=True)
+        test["client"] = SeededViolationClient(state, bad_at=5)
+        test = core.run(test)
+        assert test["aborted"] == "watchdog"
+        # aborted well before the 2000-op budget
+        assert len(test["history"]) < 2000
+        wd = test["results"]["watchdog"]
+        assert wd["tripped"] and wd["aborted"] == "watchdog"
+
+    def test_monitor_graph_rendered_by_perf_checker(self, tmp_path):
+        test = _register_test(tmp_path, "mon-graph")
+        test["checker"] = jchecker.compose({
+            "stats": jchecker.stats(), "perf": jchecker.perf()})
+        test = core.run(test)
+        assert test["results"]["valid?"] is True
+        d = jstore.path(test)
+        assert (d / "monitor.png").exists()
+
+    def test_interpreter_floor_with_monitor_enabled(self):
+        """ISSUE-3 acceptance: the hot loop keeps its throughput with
+        monitor + watchdog attached. The bound is RELATIVE to a bare
+        run measured back-to-back (the CI box throttles by shares, so
+        an absolute floor alone flakes when the whole suite is hot —
+        both configurations degrade together, the ratio doesn't),
+        plus a loose absolute sanity floor."""
+        n = 2000
+
+        def one(monitored: bool) -> float:
+            t = testing.noop_test()
+            t.update(concurrency=10, client=jclient.noop,
+                     generator=gen.clients(gen.limit(
+                         n, gen.repeat({"f": "write", "value": 1}))))
+            if monitored:
+                t["monitor"] = jmonitor.Monitor(t, interval_s=0.25)
+                t["watchdog"] = watchdog.from_test({"watchdog": True})
+                t["monitor"].start()
+            util.init_relative_time()
+            t0 = time.monotonic()
+            t = interpreter.run(dict(t))
+            dt = time.monotonic() - t0
+            if monitored:
+                t["monitor"].stop()
+                assert not t["watchdog"].tripped
+            assert len(t["history"]) == 2 * n
+            return n / dt
+
+        one(True)  # warm
+        bare = max(one(False) for _ in range(3))
+        rates = []
+        for _attempt in range(3):
+            rates.append(one(True))
+            if rates[-1] > 0.5 * bare:
+                break
+        best = max(rates)
+        assert best > 0.5 * bare and best > 500, \
+            (f"monitored {[f'{r:.0f}' for r in rates]} ops/s "
+             f"vs bare {bare:.0f}")
+
+
+# ---------------------------------------------------------------------------
+# Chrome-trace export
+# ---------------------------------------------------------------------------
+
+class InfoNemesis(testing.jnemesis.Nemesis):
+    def invoke(self, test, op):
+        return op.copy(type="info")
+
+
+class TestTraceExport:
+    def test_cli_trace_produces_valid_chrome_trace(self, tmp_path,
+                                                   capsys):
+        test = _register_test(tmp_path, "trace-e2e", n=30)
+        test["nemesis"] = InfoNemesis()
+        test["generator"] = gen.phases(
+            gen.nemesis(gen.limit(2, [{"f": "start"}, {"f": "stop"}])),
+            test["generator"])
+        test = core.run(test)
+        d = jstore.path(test)
+        with pytest.raises(SystemExit) as e:
+            cli.run_cli(cli.trace_cmd(), ["trace", str(d)])
+        assert e.value.code == 0
+        out = capsys.readouterr().out
+        assert "trace.json" in out
+        with open(d / "trace.json") as f:
+            doc = json.load(f)  # valid JSON, by construction of load
+        assert set(doc) >= {"traceEvents", "displayTimeUnit"}
+        evs = doc["traceEvents"]
+        for e2 in evs:
+            assert {"name", "ph", "pid", "tid"} <= set(e2)
+            assert e2["ph"] in ("X", "M")
+            if e2["ph"] == "X":
+                assert "ts" in e2 and "dur" in e2 and e2["dur"] > 0
+        cats = {e2.get("cat") for e2 in evs}
+        assert {"span", "op", "nemesis"} <= cats
+        # one op slice per client invocation, on per-process tracks
+        ops = [e2 for e2 in evs if e2.get("cat") == "op"]
+        invokes = [o for o in test["history"] if o.type == "invoke"]
+        assert len(ops) == len(invokes)
+        assert len({e2["tid"] for e2 in ops}) >= 2  # >1 process track
+        # nemesis window: start..stop became one slice
+        nem = [e2 for e2 in evs if e2.get("cat") == "nemesis"]
+        assert len(nem) == 1
+        # spans include the run lifecycle
+        span_names = {e2["name"] for e2 in evs
+                      if e2.get("cat") == "span"}
+        assert {"run", "case", "analyze"} <= span_names
+
+    def test_trace_cmd_missing_run(self, tmp_path, capsys):
+        with pytest.raises(SystemExit) as e:
+            cli.run_cli(cli.trace_cmd(),
+                        ["trace", str(tmp_path / "nope"),
+                         "--store", str(tmp_path)])
+        assert e.value.code == 254
+
+
+# ---------------------------------------------------------------------------
+# /live/ SSE endpoint
+# ---------------------------------------------------------------------------
+
+class TestLiveEndpoint:
+    def test_sse_streams_during_in_progress_run(self, tmp_path,
+                                                monkeypatch):
+        """ISSUE-3 acceptance: /live/ streams ≥1 SSE event while a
+        dummy-remote run is still executing."""
+        from jepsen_tpu import web
+
+        monkeypatch.setattr(web, "SSE_POLL_S", 0.05)
+        server = web.serve("127.0.0.1", 0, base=tmp_path)
+        port = server.server_address[1]
+        test = _register_test(tmp_path, "live-e2e", n=400)
+        # pace the run to ~2s so the client catches it mid-flight
+        test["generator"] = gen.clients(gen.time_limit(
+            2.0, gen.stagger(0.01, gen.repeat({"f": "read"}))))
+        box = {}
+        th = threading.Thread(
+            target=lambda: box.update(t=core.run(test)), daemon=True)
+        try:
+            th.start()
+            deadline = time.time() + 10
+            resp = None
+            while resp is None and time.time() < deadline:
+                try:
+                    resp = urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/live/?events=1",
+                        timeout=10)
+                except urllib.error.HTTPError:
+                    time.sleep(0.05)  # run (current link) not up yet
+            assert resp is not None, "no /live/ run appeared"
+            events = []
+            while len(events) < 2:
+                line = resp.readline().decode()
+                assert line, "SSE stream ended before any event"
+                if line.startswith("data: "):
+                    events.append(json.loads(line[len("data: "):]))
+            resp.close()
+            assert th.is_alive() or events  # streamed while running
+            assert all("t" in p for p in events)
+            # the live page embeds the EventSource wiring
+            page = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/live/",
+                timeout=5).read().decode()
+            assert "EventSource" in page and "ops/s" in page
+        finally:
+            th.join(timeout=30)
+            server.shutdown()
+        assert box["t"]["results"]["valid?"] is True
+
+    def test_sse_replays_finished_run_then_ends(self, tmp_path):
+        from jepsen_tpu import web
+
+        test = core.run(_register_test(tmp_path, "live-replay"))
+        d = jstore.path(test)
+        rel = f"live-replay/{d.name}"
+        server = web.serve("127.0.0.1", 0, base=tmp_path)
+        port = server.server_address[1]
+        try:
+            resp = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/live/{rel}?events=1",
+                timeout=10)
+            n = 0
+            saw_end = False
+            deadline = time.time() + 10
+            while time.time() < deadline:
+                line = resp.readline().decode()
+                if line.startswith("data: "):
+                    n += 1
+                if line.startswith("event: end"):
+                    saw_end = True
+                    break
+            assert n >= 1 and saw_end
+            # run dirs link their rendered views
+            listing = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/files/{rel}/",
+                timeout=5).read().decode()
+            assert f"/live/{rel}" in listing
+            assert f"/telemetry/{rel}" in listing
+        finally:
+            server.shutdown()
+
+    def test_live_404_on_unknown_run(self, tmp_path):
+        import urllib.error
+
+        from jepsen_tpu import web
+
+        server = web.serve("127.0.0.1", 0, base=tmp_path)
+        port = server.server_address[1]
+        try:
+            with pytest.raises(urllib.error.HTTPError) as he:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/live/nope/run",
+                    timeout=5)
+            assert he.value.code == 404
+        finally:
+            server.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Kafka realtime lag
+# ---------------------------------------------------------------------------
+
+class TestKafkaLag:
+    def test_checker_emits_lag_stats_tail(self):
+        from jepsen_tpu.workloads import kafka
+
+        ms = 1_000_000
+        ops = [
+            # p0 sends v1..v3 to key 0 at t=1,2,3
+            dict(index=0, time=0 * ms, type="invoke", process=0,
+                 f="send", value=[["send", 0, 1]]),
+            dict(index=1, time=1 * ms, type="ok", process=0,
+                 f="send", value=[["send", 0, [0, 1]]]),
+            dict(index=2, time=1 * ms, type="invoke", process=0,
+                 f="send", value=[["send", 0, 2]]),
+            dict(index=3, time=2 * ms, type="ok", process=0,
+                 f="send", value=[["send", 0, [1, 2]]]),
+            # p1 polls only v1 at t=50 -> lagging behind v2 (acked t=2)
+            dict(index=4, time=3 * ms, type="invoke", process=1,
+                 f="poll", value=[["poll"]]),
+            dict(index=5, time=50 * ms, type="ok", process=1,
+                 f="poll", value=[["poll", {0: [[0, 1]]}]]),
+            # then catches up at t=60
+            dict(index=6, time=51 * ms, type="invoke", process=1,
+                 f="poll", value=[["poll"]]),
+            dict(index=7, time=60 * ms, type="ok", process=1,
+                 f="poll", value=[["poll", {0: [[1, 2]]}]]),
+        ]
+        res = kafka.check(ops)
+        lag = res["realtime-lag"]
+        # at t=50 the oldest unpolled acked message (v2, acked t=2)
+        # was 48ms old; after the catch-up poll the lag is 0
+        assert lag["max-lag-ms"] == pytest.approx(48.0)
+        assert lag["worst-realtime-lag"]["process"] == 1
+        assert lag["worst-realtime-lag"]["key"] == 0
+        assert lag["final-lags-ms"] == {"1:0": 0.0}
+        assert lag["unseen-at-end"] == {}
+
+    def test_unseen_at_end_reported(self):
+        from jepsen_tpu.workloads import kafka
+
+        ops = [
+            dict(index=0, time=0, type="invoke", process=0, f="send",
+                 value=[["send", 0, 1]]),
+            dict(index=1, time=1, type="ok", process=0, f="send",
+                 value=[["send", 0, [0, 1]]]),
+        ]
+        res = kafka.check(ops)
+        assert res["realtime-lag"]["unseen-at-end"] == {0: 1}
+        assert res["realtime-lag"]["max-lag-ms"] == 0.0
+
+    def test_lag_probe_streams_into_monitor(self):
+        from jepsen_tpu.workloads import kafka
+
+        util.init_relative_time()
+        m = jmonitor.Monitor({"monitor_probes": [kafka.lag_probe]},
+                             interval_s=99)
+        ms = 1_000_000
+        send = Op(type="ok", process=0, f="send", time=2 * ms,
+                  value=[["send", 0, [0, "a"]], ["send", 0, [1, "b"]]])
+        m.on_complete(send, 0, 2 * ms)
+        poll = Op(type="ok", process=1, f="poll", time=30 * ms,
+                  value=[["poll", {0: [[0, "a"]]}]])
+        m.on_complete(poll, 1, 30 * ms)
+        p = m.sample()
+        # offset 1 ("b", acked t=2ms) still unpolled at t=30ms
+        assert p["probes"]["kafka.realtime-lag-ms"] == pytest.approx(
+            28.0)
+        caught_up = Op(type="ok", process=1, f="poll", time=40 * ms,
+                       value=[["poll", {0: [[1, "b"]]}]])
+        m.on_complete(caught_up, 1, 40 * ms)
+        assert m.sample()["probes"]["kafka.realtime-lag-ms"] == 0.0
+
+    def test_kafka_workload_declares_probe(self):
+        from jepsen_tpu.workloads import kafka
+
+        w = kafka.workload({"ops": 10})
+        assert w["monitor_probes"] == [kafka.lag_probe]
