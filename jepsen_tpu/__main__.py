@@ -112,6 +112,9 @@ def make_test(opts: dict) -> dict:
                              "perf": chk.perf(),
                              "timeline": chk.timeline()}),
         generator=_generator(opts, w))
+    if opts.get("trace"):
+        # per-op causal tracing (optrace.jsonl + anomaly provenance)
+        test["trace?"] = True
     for k, v in w.items():
         if k not in ("generator", "checker", "final_generator"):
             test[k] = v
@@ -147,6 +150,9 @@ def _workload_opt(p):
                    help="Rough op budget for the workload generator.")
     p.add_argument("--rate", type=float, default=100,
                    help="Target ops/sec across all workers.")
+    p.add_argument("--trace", action="store_true",
+                   help="Record the per-op causal trace "
+                        "(optrace.jsonl; see doc/observability.md).")
     return p
 
 

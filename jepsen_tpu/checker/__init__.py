@@ -56,6 +56,33 @@ def check_safe(checker: Checker, test, hist, opts=None) -> dict:
         return {"valid?": "unknown", "error": traceback.format_exc()}
 
 
+def op_indices(hist: History | None, *ops) -> list[int]:
+    """Participating op (invocation) indices for a group of ops —
+    anomaly provenance, joining verdicts to the per-op trace
+    (optrace.jsonl) and timeline anchors. Completion ops resolve to
+    their invocation when the history is given."""
+    idxs = set()
+    for o in ops:
+        if o is None:
+            continue
+        idx = getattr(o, "index", None)
+        if idx is None and isinstance(o, dict):
+            idx = o.get("index")
+        if not isinstance(idx, int) or idx < 0:
+            continue
+        ty = getattr(o, "type", None) or (
+            o.get("type") if isinstance(o, dict) else None)
+        if hist is not None and ty is not None and ty != "invoke":
+            try:
+                inv = hist.invocation(o)
+                if inv is not None:
+                    idx = inv.index
+            except (KeyError, TypeError, AttributeError):
+                pass
+        idxs.add(idx)
+    return sorted(idxs)
+
+
 def merge_valid(valids) -> Any:
     """false dominates, then unknown, else true."""
     out: Any = True
@@ -274,6 +301,11 @@ class Linearizable(Checker):
                     / f"linear-counterexample-{fp}.svg")
                 if p:
                     out["counterexample-svg"] = p
+                # provenance: the counterexample's op-indices resolve
+                # to per-op trace excerpts when the run was traced
+                p2 = explain.write_linear_trace_excerpt(store_dir, out)
+                if p2:
+                    out["trace-excerpt"] = p2
             except Exception:  # noqa: BLE001 — rendering is best-effort
                 import logging
 
@@ -664,6 +696,14 @@ def set_full(checker_opts: dict | None = None) -> Checker:
             "duplicated-count": len(dups),
             "duplicated": dups,
         }
+        if lost_n:
+            # provenance for lost elements: the op indices proving
+            # existence (known) and loss (last-absent), joinable to
+            # the per-op trace and timeline
+            out["lost-op-indices"] = {
+                r["element"]: op_indices(hist, r["known"],
+                                         r["last-absent"])
+                for r in outcomes.get("lost", [])}
         points = [0, 0.5, 0.95, 0.99, 1]
         if stable_lat:
             out["stable-latencies"] = _frequency_distribution(

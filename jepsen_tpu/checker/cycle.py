@@ -25,6 +25,9 @@ def _with_artifacts(test, result: dict) -> dict:
             from ..reports import explain
 
             paths = explain.write_elle_artifacts(store_dir, result)
+            # anomaly provenance: resolve each anomaly's op-indices
+            # into trace excerpts when the run carried optrace.jsonl
+            paths += explain.write_trace_excerpts(store_dir, result)
             if paths:
                 result = dict(result)
                 result["artifacts"] = paths

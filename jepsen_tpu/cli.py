@@ -322,6 +322,10 @@ def trace_cmd() -> dict:
         _store_run_opts(p)
         p.add_argument("-o", "--out", default=None,
                        help="Output path (default: <run>/trace.json).")
+        p.add_argument("--ops", default=None,
+                       help="Comma-separated op indices: restrict the "
+                            "client tracks to these ops (anomaly "
+                            "provenance drill-down).")
         return p
 
     def run(options):
@@ -331,7 +335,9 @@ def trace_cmd() -> dict:
         if d is None:
             print(f"no such stored test: {options.test}")
             return 254
-        out = rtrace.write_trace(d, options.out)
+        ops = ([int(x) for x in options.ops.split(",") if x.strip()]
+               if options.ops else None)
+        out = rtrace.write_trace(d, options.out, ops=ops)
         print(f"wrote {out}")
         print("open it at https://ui.perfetto.dev "
               "(or chrome://tracing)")

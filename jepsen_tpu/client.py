@@ -148,6 +148,66 @@ def timeout(timeout_or_fn, client: Client) -> Timeout:
     return Timeout(lambda _op: timeout_or_fn, client)
 
 
+class Traced(Client):
+    """Per-call client tracing (the dgraph/src/jepsen/dgraph/trace.clj
+    analog): wraps invoke — and reopen-during-invoke — in a 'client'
+    child span of the ambient op trace, so the client round-trip is
+    visible as its own slice under the op's lifetime. A no-op when
+    tracing is disabled (jepsen_tpu.tracing gates every record on one
+    enabled check)."""
+
+    def __init__(self, client: Client):
+        self.client = client
+
+    def open(self, test, node):
+        from . import tracing
+
+        with tracing.span("client", "client.open",
+                          node=util.name_str(node)
+                          if node is not None else None):
+            return Traced(self.client.open(test, node))
+
+    def setup(self, test):
+        return Traced(self.client.setup(test))
+
+    def invoke(self, test, op):
+        from . import tracing
+
+        with tracing.span("client", f"client.{op.f}") as rec:
+            op2 = self.client.invoke(test, op)
+            if rec is not None:
+                rec.setdefault("attrs", {})["type"] = op2.type
+            return op2
+
+    def teardown(self, test):
+        self.client.teardown(test)
+
+    def close(self, test):
+        from . import tracing
+
+        with tracing.span("client", "client.close"):
+            self.client.close(test)
+
+    def reusable(self, test):
+        return is_reusable(self.client, test)
+
+
+def traced(client: Client) -> Traced:
+    return Traced(client)
+
+
+def should_trace(test) -> bool:
+    """Whether the interpreter should wrap this test's client in
+    Traced: tracing must be on for the run (test['trace?'], wired by
+    core.run), and suites opt out of per-call client spans with
+    test['trace_clients?'] = False (or force the wrapper on a client
+    they build themselves via traced())."""
+    from . import tracing
+
+    return (tracing.get().enabled
+            and test.get("trace_clients?", True) is not False)
+
+
 def definite_http_failure(e: Exception) -> bool:
     """True when an HTTP request certainly never executed — a refused
     connection — so the op is a safe definite :fail. Timeouts, resets

@@ -21,7 +21,8 @@ from contextlib import contextmanager
 from typing import Any, Callable
 
 from .core import (Action, Remote, RemoteError, Result, Session, escape,
-                   join_cmd, throw_on_nonzero_exit, wrap_sudo)
+                   join_cmd, throw_on_nonzero_exit, traced_execute,
+                   traced_transfer, wrap_sudo)
 from .dummy import DummyRemote, dummy
 
 logger = logging.getLogger(__name__)
@@ -140,7 +141,7 @@ def exec_(*args, stdin: str | None = None, check: bool = True,
     cmd = join_cmd(*args)
     action = Action(cmd=cmd, stdin=stdin, sudo=_sudo.get(), dir=_dir.get(),
                     timeout=timeout)
-    res = current_session().execute(action)
+    res = traced_execute(current_session(), action, node=current_node())
     if check:
         throw_on_nonzero_exit(current_node(), res)
     return res.out.strip()
@@ -152,15 +153,17 @@ def exec_result(*args, stdin: str | None = None,
     cmd = join_cmd(*args)
     action = Action(cmd=cmd, stdin=stdin, sudo=_sudo.get(), dir=_dir.get(),
                     timeout=timeout)
-    return current_session().execute(action)
+    return traced_execute(current_session(), action, node=current_node())
 
 
 def upload(local_paths, remote_path) -> None:
-    current_session().upload(local_paths, remote_path)
+    traced_transfer(current_session(), "upload", local_paths,
+                    remote_path, node=current_node())
 
 
 def download(remote_paths, local_path) -> None:
-    current_session().download(remote_paths, local_path)
+    traced_transfer(current_session(), "download", remote_paths,
+                    local_path, node=current_node())
 
 
 def on_nodes(test: dict, f: Callable[[dict, Any], Any],
@@ -173,12 +176,19 @@ def on_nodes(test: dict, f: Callable[[dict, Any], Any],
     if not nodes:
         return {}
 
+    from .. import tracing
+
+    # capture the calling thread's trace context so the pooled
+    # per-node commands record under the op that issued them
+    trace_parent = tracing.get().current()
+
     def run_one(node):
         ctx = contextvars.copy_context()
 
         def body():
-            with with_session(test, node):
-                return f(test, node)
+            with tracing.get().attach(trace_parent):
+                with with_session(test, node):
+                    return f(test, node)
 
         return ctx.run(body)
 

@@ -134,3 +134,62 @@ def throw_on_nonzero_exit(node, res: Result) -> Result:
                           exit=res.exit, out=res.out, err=res.err,
                           cmd=res.cmd, node=node)
     return res
+
+
+# Attribute budget for traced commands: enough to identify the command
+# in a trace viewer without shipping multi-KB stdin/scripts along.
+_TRACE_CMD_CHARS = 200
+
+
+def traced_execute(session: "Session", action: Action,
+                   node=None) -> Result:
+    """Runs `action` through `session.execute` inside a 'remote' trace
+    span carrying cmd, node, duration, and exit code — one child span
+    per remote command under the op that issued it (the tracing layer
+    no-ops unless the run opted in and an op context is open on this
+    thread). Transport/remote errors close the span with the error
+    class; the retry layer stamps its attempt count on the same span
+    via tracing.annotate."""
+    from .. import tracing
+
+    tr = tracing.get()
+    if not tr.enabled:
+        return session.execute(action)
+    cmd = action.cmd or ""
+    name = cmd.split(None, 1)[0] if cmd.split() else "(empty)"
+    with tr.span("remote", f"remote.{name}",
+                 cmd=cmd[:_TRACE_CMD_CHARS],
+                 node=str(node) if node is not None else None,
+                 sudo=action.sudo) as rec:
+        try:
+            res = session.execute(action)
+        except RemoteError as e:
+            if rec is not None:
+                rec.setdefault("attrs", {}).update(
+                    error=type(e).__name__, exit=e.exit)
+            raise
+        if rec is not None:
+            rec.setdefault("attrs", {})["exit"] = res.exit
+        return res
+
+
+def traced_transfer(session: "Session", direction: str, paths,
+                    dest, node=None):
+    """upload/download under a 'remote' trace span (scp commands are
+    remote work too — a snarf or data-file push shows up in the op
+    trace like any command)."""
+    from .. import tracing
+
+    tr = tracing.get()
+    fn = getattr(session, direction)
+    if not tr.enabled:
+        return fn(paths, dest)
+    with tr.span("remote", f"remote.scp.{direction}",
+                 node=str(node) if node is not None else None) as rec:
+        try:
+            return fn(paths, dest)
+        except RemoteError as e:
+            if rec is not None:
+                rec.setdefault("attrs", {}).update(
+                    error=type(e).__name__, exit=e.exit)
+            raise

@@ -14,7 +14,7 @@ from __future__ import annotations
 import random
 import time
 
-from .. import reconnect
+from .. import reconnect, tracing
 from .core import Action, Remote, Result, Session, TransportError
 
 RETRIES = 5
@@ -41,10 +41,19 @@ class RetryingSession(Session):
                 with self.wrapper.with_conn(
                         cycle_on=TransportError) as sess:
                     return f(sess)
-            except TransportError:
+            except TransportError as e:
                 if tries <= 0:
                     raise
                 tries -= 1
+                # stamp the attempt count on the ambient 'remote'
+                # trace span (control.traced_execute opened it around
+                # this whole retry loop), so a command that limped
+                # through on attempt 3 carries retries=3
+                tracing.annotate(retries=RETRIES - tries)
+                tracing.event("remote-retry",
+                              node=self.conn_spec.get("host"),
+                              attempt=RETRIES - tries,
+                              error=str(e)[:160])
                 time.sleep(BACKOFF_S / 2 + random.random() * BACKOFF_S)
 
     def execute(self, action: Action) -> Result:

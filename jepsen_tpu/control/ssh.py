@@ -16,6 +16,7 @@ import tempfile
 import threading
 from typing import Optional
 
+from .. import tracing
 from .core import (Action, Remote, RemoteError, Result, Session,
                    TransportError, wrap_sudo)
 
@@ -73,6 +74,8 @@ class SshSession(Session):
         except subprocess.TimeoutExpired as e:
             # NOT a TransportError: the command started and may still
             # be running remotely — retrying would double-execute it
+            tracing.event("ssh-timeout", node=self.host,
+                          timeout_s=action.timeout)
             raise RemoteError("ssh command timed out", cmd=cmd,
                               node=self.host) from e
         except OSError as e:  # spawn failure (e.g. no ssh binary)
@@ -84,6 +87,8 @@ class SshSession(Session):
             # (connect/auth/channel): retryable. A remote command that
             # itself exits 255 without such a message passes through as
             # a Result, preserving exec_result's no-raise contract.
+            tracing.event("ssh-transport-failed", node=self.host,
+                          stderr=(proc.stderr or "")[:160])
             raise TransportError("ssh transport failed", exit=255,
                                  out=proc.stdout, err=proc.stderr,
                                  cmd=cmd, node=self.host)

@@ -26,6 +26,7 @@ from . import interpreter
 from . import monitor as jmonitor
 from . import nemesis as jnemesis
 from . import telemetry
+from . import tracing
 from . import util
 from . import watchdog as jwatchdog
 from .history import History
@@ -258,6 +259,13 @@ def run(test: dict) -> dict:
         # times); nothing in analysis reads the ambient origin itself.
         with util.with_relative_time():
             telemetry.reset()
+            # per-op causal tracing is opt-in (test["trace?"]); when a
+            # store exists the recorder streams optrace.jsonl into it
+            # as spans complete (crash-tolerant like telemetry.jsonl)
+            tracer = tracing.get()
+            tracer.reset(enabled=bool(test.get("trace?")))
+            if tracer.enabled and test.get("store_dir"):
+                tracer.open(Path(test["store_dir"]) / tracing.TRACE_FILE)
             # the live monitor + online watchdog span the whole run:
             # the sampler sees setup, the case, AND analysis (device
             # occupancy gauges appear mid-analyze), streaming points
@@ -294,6 +302,9 @@ def run(test: dict) -> dict:
                     finally:
                         control.close_sessions(test)
 
+                # checkers read optrace.jsonl (timeline hover, trace
+                # excerpts): push any buffered records out first
+                tracer.flush()
                 test = analyze(test, store_ctx)
                 # final monitor point BEFORE results.json: /live/
                 # tailers treat results.json as the end-of-run marker
@@ -312,6 +323,12 @@ def run(test: dict) -> dict:
                         telemetry.save(test["store_dir"])
                     except Exception:  # noqa: BLE001 — best-effort
                         logger.exception("saving telemetry failed")
+                # the op-trace stream is already on disk; close it so
+                # the tail is flushed even when the run crashed
+                try:
+                    tracer.close()
+                except Exception:  # noqa: BLE001 — best-effort
+                    logger.exception("closing optrace failed")
     finally:
         # a crashed lifecycle must not leak the per-test log handler
         if store_ctx:

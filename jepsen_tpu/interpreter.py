@@ -21,6 +21,7 @@ from typing import Any
 from . import client as jclient
 from . import generator as gen
 from . import telemetry
+from . import tracing
 from . import util
 from .generator.context import NEMESIS
 from .history import History, Op
@@ -66,8 +67,13 @@ class ClientWorker(Worker):
                     and not jclient.is_reusable(self.client, test)):
                 self.close(test)
                 try:
-                    self.client = jclient.validate(test["client"]).open(
-                        test, self.node)
+                    c = jclient.validate(test["client"])
+                    if jclient.should_trace(test):
+                        # the traced_client wrapper (dgraph trace.clj
+                        # analog): each client call becomes a child
+                        # span of the op's trace context
+                        c = jclient.Traced(c)
+                    self.client = c.open(test, self.node)
                     self.process = op.process
                 except Exception as e:  # noqa: BLE001
                     logger.warning("Error opening client: %s", e)
@@ -112,6 +118,7 @@ def spawn_worker(test, out: queue.Queue, worker: Worker, wid):
         # hot loop must not contend on the recorder's lock across all
         # worker threads (the throughput floor test polices this path)
         tel = telemetry.get()
+        tracer = tracing.get()
         epoch0 = tel.epoch
         invoke_ns = 0
         type_counts: dict = {}
@@ -131,7 +138,16 @@ def spawn_worker(test, out: queue.Queue, worker: Worker, wid):
                         out.put(op)
                     else:
                         t0 = _t.monotonic_ns()
-                        op2 = w.invoke(test, op)
+                        if tracer.enabled:
+                            # mint the op's trace context: trace id =
+                            # the invocation's op index, so client/
+                            # remote child spans join the history
+                            with tracer.op_span(op) as trec:
+                                op2 = w.invoke(test, op)
+                                if trec is not None:
+                                    trec["status"] = op2.type
+                        else:
+                            op2 = w.invoke(test, op)
                         invoke_ns += _t.monotonic_ns() - t0
                         t0 = None
                         type_counts[op2.type] = type_counts.get(

@@ -22,6 +22,16 @@ from .util import real_pmap
 TC = "/sbin/tc"
 
 
+def _trace_net(name: str, **attrs) -> None:
+    """Partition/shaping changes as span events on the nemesis op that
+    applied them (the nemesis worker's invoke carries the ambient
+    trace context), so a cycle that closed across a partition window
+    links straight to the iptables/tc change that opened it."""
+    from . import tracing
+
+    tracing.event(f"net.{name}", **attrs)
+
+
 # ---------------------------------------------------------------------------
 # IP resolution (control/net.clj)
 # ---------------------------------------------------------------------------
@@ -246,7 +256,10 @@ def _net_shape(net, test, targets, behavior):
     results = control.on_nodes(
         test, lambda t, n: _shape_on_node(t, n, targets, behavior))
     if targets and behavior:
+        _trace_net("shape", targets=sorted(map(str, targets or ())),
+                   behavior=sorted(behavior or ()))
         return ["shaped", results, "netem", behaviors_to_netem(behavior)]
+    _trace_net("shape-clear")
     return ["reliable", results]
 
 
@@ -259,6 +272,7 @@ class IPTables(Net):
                 control.exec_("iptables", "-A", "INPUT", "-s", ip(src),
                               "-j", "DROP", "-w")
         control.on_nodes(test, body, [dest])
+        _trace_net("drop", src=str(src), dest=str(dest))
 
     def heal(self, test):
         def body(t, n):
@@ -266,6 +280,7 @@ class IPTables(Net):
                 control.exec_("iptables", "-F", "-w")
                 control.exec_("iptables", "-X", "-w")
         control.on_nodes(test, body)
+        _trace_net("heal")
 
     def slow(self, test, mean=50, variance=10, distribution="normal"):
         def body(t, n):
@@ -309,6 +324,9 @@ class IPTables(Net):
                               ",".join(ip(s) for s in sorted(srcs)),
                               "-j", "DROP", "-w")
         control.on_nodes(test, snub, list(grudge.keys()))
+        _trace_net("partition",
+                   grudge={str(n): sorted(map(str, srcs))
+                           for n, srcs in grudge.items() if srcs})
 
 
 class IPFilter(Net):
@@ -320,12 +338,14 @@ class IPFilter(Net):
                 control.exec_("echo", "block", "in", "from", src, "to",
                               "any", Lit("|"), "ipf", "-f", "-")
         control.on_nodes(test, body, [dest])
+        _trace_net("drop", src=str(src), dest=str(dest))
 
     def heal(self, test):
         def body(t, n):
             with control.su():
                 control.exec_("ipf", "-Fa")
         control.on_nodes(test, body)
+        _trace_net("heal")
 
     slow = IPTables.slow
     flaky = IPTables.flaky

@@ -79,6 +79,13 @@ class Wrapper:
         elif self.log:
             logger.exception("reconnect %r: error; reopening",
                              self.name)
+        # reconnects are span events on the op whose failure forced
+        # them (or context-free during setup) — an op that limped
+        # through a connection cycle carries the evidence
+        from . import tracing
+
+        tracing.event("reconnect", wrapper=str(self.name),
+                      error=repr(exc)[:160])
         with self._lock:
             if self._conn is conn:
                 try:

@@ -11,6 +11,13 @@ Here both artifacts are dependency-free: anomaly files are plain text,
 cycle plots are hand-rolled SVG (circular layout) plus graphviz dot
 text, and the linearizability counterexample is an SVG timeline of the
 ops in flight at the stuck point, one lane per process.
+
+Anomaly provenance: when the run carried the per-op causal trace
+(optrace.jsonl, jepsen_tpu.tracing), each anomaly's participating op
+indices (the `op-indices` the checkers attach) resolve into *trace
+excerpts* — the client calls, remote commands, retries and fault
+events behind exactly those ops — written next to the anomaly files
+and linked from the web UI.
 """
 
 from __future__ import annotations
@@ -110,6 +117,98 @@ def write_elle_artifacts(store_dir, result: dict,
         p.write_text("\n".join(dot_lines))
         written.append(str(p))
     return written
+
+
+# ---------------------------------------------------------------------------
+# Per-anomaly trace excerpts (anomaly provenance)
+# ---------------------------------------------------------------------------
+
+_EXCERPT_RECORDS_PER_OP = 12
+
+
+def trace_excerpt_lines(by_op: dict, indices) -> list[str]:
+    """Text lines describing the trace records behind the given op
+    (invocation) indices: for each op, its root span then every
+    client/remote span and event, one compact line each
+    (tracing.describe)."""
+    from .. import tracing as jtracing
+
+    lines: list[str] = []
+    for i in indices:
+        recs = by_op.get(i)
+        if not recs:
+            lines.append(f"op {i}: (no trace records)")
+            continue
+        lines.append(f"op {i}:")
+        recs = sorted(recs, key=lambda r: (r.get("t0", 0),
+                                           r.get("span", 0)))
+        for rec in recs[:_EXCERPT_RECORDS_PER_OP]:
+            lines.append(f"  {jtracing.describe(rec)}")
+        if len(recs) > _EXCERPT_RECORDS_PER_OP:
+            lines.append(f"  … {len(recs) - _EXCERPT_RECORDS_PER_OP} "
+                         "more record(s)")
+    return lines
+
+
+def _load_by_op(store_dir, optrace):
+    from .. import tracing as jtracing
+
+    if optrace is None:
+        from .. import store as jstore
+
+        optrace = jstore.load_optrace(store_dir)
+    return jtracing.by_op(optrace or [])
+
+
+def write_trace_excerpts(store_dir, result: dict, optrace=None,
+                         subdir: str = "elle") -> list[str]:
+    """Resolves each anomaly's op-indices into a per-anomaly trace
+    excerpt file (<name>-trace-<fp>.txt next to the anomaly files);
+    returns the written paths. No-op when the run wasn't traced or no
+    record carries op-indices."""
+    anomalies = (result or {}).get("anomalies") or {}
+    if not anomalies:
+        return []
+    by_op = _load_by_op(store_dir, optrace)
+    if not by_op:
+        return []
+    out_dir = Path(store_dir) / subdir
+    fp = _fingerprint(sorted((k, repr(v)) for k, v in anomalies.items()))
+    written: list[str] = []
+    for name, records in sorted(anomalies.items()):
+        idxs = sorted({i for rec in records if isinstance(rec, dict)
+                       for i in rec.get("op-indices") or []})
+        if not idxs:
+            continue
+        body = [f"{name}: trace excerpts for participating ops "
+                f"{idxs}", ""]
+        body.extend(trace_excerpt_lines(by_op, idxs))
+        out_dir.mkdir(parents=True, exist_ok=True)
+        p = out_dir / f"{name}-trace-{fp}.txt"
+        p.write_text("\n".join(body) + "\n")
+        written.append(str(p))
+    return written
+
+
+def write_linear_trace_excerpt(store_dir, analysis: dict,
+                               optrace=None) -> str | None:
+    """The linearizability counterexample's trace excerpt: the stuck
+    op, its predecessor, and the pending ops (analysis['op-indices'],
+    attached by tpu/wgl), resolved against the per-op trace. Returns
+    the path written, or None when untraced/valid."""
+    idxs = (analysis or {}).get("op-indices") or []
+    if not idxs or analysis.get("valid?") is not False:
+        return None
+    by_op = _load_by_op(store_dir, optrace)
+    if not any(i in by_op for i in idxs):
+        return None
+    fp = _fingerprint(tuple(idxs))
+    body = [f"linearizability counterexample: trace excerpts for "
+            f"participating ops {sorted(idxs)}", ""]
+    body.extend(trace_excerpt_lines(by_op, sorted(idxs)))
+    p = Path(store_dir) / f"linear-counterexample-trace-{fp}.txt"
+    p.write_text("\n".join(body) + "\n")
+    return str(p)
 
 
 def _cycle_svg(name: str, steps: list[dict], cycle_ops=None) -> str:

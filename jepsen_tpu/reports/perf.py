@@ -273,6 +273,53 @@ def monitor_preview(test, history: History, opts=None) -> dict:
     return {"valid?": True, "file": path, "points": len(series)}
 
 
+def balances_preview(test, history: History, opts=None) -> dict:
+    """Per-account balance over time from the bank workload's ok reads
+    (the bank.clj:150-176 plot analog: one line per account, every
+    read a sample point), with the shared nemesis shading so a balance
+    excursion lines up with the fault window that caused it. Writes
+    bank-balances.png."""
+    history = history.client_ops()
+    series: dict = defaultdict(list)  # account -> [(t, balance)]
+    for o in history:
+        if is_ok(o) and o.f == "read" and isinstance(o.value, dict):
+            t = util.nanos_to_secs(o.time)
+            for acct, bal in o.value.items():
+                series[acct].append((t, bal))
+    if not series:
+        return {"valid?": True}
+    plt, fig, ax = _figure()
+    ax.set_ylabel("Balance")
+    ax.set_title(f"{test.get('name') or 'test'} account balances")
+    for acct in sorted(series, key=str):
+        pts = series[acct]
+        ax.plot([t for t, _ in pts], [b for _, b in pts],
+                lw=1.0, alpha=0.8, label=f"acct {acct}", zorder=2)
+    ax.axhline(0, color="#888", lw=0.8, ls="--", zorder=1)
+    _shade_nemeses(ax, test, history)
+    ax.legend(loc="upper right", fontsize=7,
+              ncol=max(1, len(series) // 8 + 1))
+    path = _save(plt, fig, test, opts, "bank-balances.png")
+    return {"valid?": True, "file": path,
+            "accounts": len(series)}
+
+
+def balance_graph(graph_opts=None):
+    """Checker rendering the bank balance-over-time plot (the
+    jepsen/tests/bank.clj plot bundle entry)."""
+    from ..checker import _Fn
+
+    def run(test, history, opts):
+        if not _plottable(test):
+            return {"valid?": True, "skipped": "no store directory"}
+        o = {**(graph_opts or {}), **(opts or {})}
+        r = balances_preview(test, history, o)
+        return {"valid?": True,
+                "files": [p for p in [r.get("file")] if p]}
+
+    return _Fn(run)
+
+
 def _plottable(test) -> bool:
     """Plots need a store directory to land in."""
     return bool(test.get("store_dir") or test.get("name"))

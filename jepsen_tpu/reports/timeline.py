@@ -60,15 +60,38 @@ def pairs(history) -> list:
     return out
 
 
-def _title(op) -> str:
+def _title(op, trace_lines=None) -> str:
     lines = [f"process {op.process}", f"type {op.type}", f"f {op.f}",
              f"index {op.index}", f"value {op.value!r}"]
     if op.ext:
         lines += [f"{k} {v!r}" for k, v in op.ext.items()]
+    if trace_lines:
+        lines.append("— trace —")
+        lines.extend(trace_lines)
     return _html.escape("\n".join(lines), quote=True)
 
 
-def render_html(test, history: History) -> str:
+_TRACE_LINE_LIMIT = 8
+"""Max per-op trace lines in a hover title."""
+
+
+def trace_titles(optrace) -> dict:
+    """{invocation op index: [hover line, ...]} from per-op trace
+    records (jepsen_tpu.tracing) — what each op *did* (client calls,
+    remote commands, retries, reconnects), surfaced where the op sits
+    on the timeline."""
+    from .. import tracing as jtracing
+
+    out: dict = {}
+    for opi, recs in jtracing.by_op(optrace or []).items():
+        lines = [jtracing.describe(r) for r in recs
+                 if r.get("kind") != "op"][:_TRACE_LINE_LIMIT]
+        if lines:
+            out[opi] = lines
+    return out
+
+
+def render_html(test, history: History, optrace=None) -> str:
     history = History(
         [o for o in history if o.type in
          ("invoke", "ok", "fail", "info")], assign_indices=False)
@@ -86,6 +109,7 @@ def render_html(test, history: History) -> str:
             processes.append(p)
     col_of = {p: i for i, p in enumerate(processes)}
     tmax = max((o.time for o in history), default=0)
+    titles = trace_titles(optrace)
 
     cells = []
     for pair in prs:
@@ -101,7 +125,8 @@ def render_html(test, history: History) -> str:
             f'<div id="op-{first.index}" class="op {typ}" '
             f'style="left:{left:.0f}px; top:{top:.1f}px; '
             f'width:{COL_WIDTH}px; height:{h:.1f}px" '
-            f'title="{_title(last)}">{_html.escape(label)}</div>')
+            f'title="{_title(last, titles.get(first.index))}">'
+            f'{_html.escape(label)}</div>')
 
     headers = "".join(
         f'<div style="position:absolute; left:{GUTTER_WIDTH * i}px; '
@@ -130,11 +155,17 @@ def html():
             return {"valid?": True, "skipped": "no store directory"}
         from .. import store as jstore
 
+        optrace = None
+        if test.get("store_dir"):
+            try:  # per-op trace detail in the hover titles, if traced
+                optrace = jstore.load_optrace(test["store_dir"]) or None
+            except OSError:
+                optrace = None
         sub = (opts or {}).get("subdirectory")
         parts = ([sub, "timeline.html"] if sub else ["timeline.html"])
         out = jstore.path(test, *parts)
         out.parent.mkdir(parents=True, exist_ok=True)
-        out.write_text(render_html(test, history))
+        out.write_text(render_html(test, history, optrace=optrace))
         return {"valid?": True, "file": str(out)}
 
     return _Fn(run)

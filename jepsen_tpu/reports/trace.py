@@ -14,11 +14,19 @@ events, per the trace-event spec):
   harness  one thread-track per recorder thread, nesting spans as the
            usual flame layout (`X` complete events)
   clients  one track per process: each op is an `X` slice from its
-           invocation to its completion, colored by completion type
+           invocation to its completion, colored by completion type.
+           When the run carried the per-op causal trace
+           (optrace.jsonl, jepsen_tpu.tracing), each op slice grows
+           nested child slices — the worker-side invoke, client
+           calls, remote (SSH) commands with exit/retry args — plus
+           instant markers for reconnects/partition events, all on
+           the same linear clock so they nest by containment.
   nemesis  one track per nemesis spec, a slice per activation window
 
 CLI: `python -m jepsen_tpu trace <run>` writes `trace.json` into the
-run's store directory (see doc/observability.md for the walkthrough).
+run's store directory (see doc/observability.md for the walkthrough);
+`--ops 3,17` (or web.py's per-anomaly links) pre-filters the export to
+the ops participating in an anomaly.
 """
 
 from __future__ import annotations
@@ -95,10 +103,11 @@ def _span_events(events: list, spans) -> int:
     return n
 
 
-def _op_events(events: list, history) -> int:
+def _op_events(events: list, history, ops_filter=None) -> "_Tids":
     """Op lifetimes: one track per process, one slice per
     invoke→complete pair. Uncompleted invokes extend to history end
-    (the same convention the timeline report uses)."""
+    (the same convention the timeline report uses). Returns the track
+    allocator so the optrace child spans land on the same tracks."""
     _process_meta(events, _PID_CLIENTS, "clients")
     tids = _Tids(events, _PID_CLIENTS, sort_index=1)
     if not isinstance(history, History):
@@ -107,6 +116,8 @@ def _op_events(events: list, history) -> int:
     n = 0
     for op in history:
         if not is_invoke(op):
+            continue
+        if ops_filter is not None and op.index not in ops_filter:
             continue
         comp = history.completion(op)
         t1 = comp.time if comp is not None else tmax
@@ -124,6 +135,47 @@ def _op_events(events: list, history) -> int:
         if comp is not None and comp.value != op.value:
             ev["args"]["result"] = repr(comp.value)
         events.append(ev)
+        n += 1
+    logger.debug("trace: %d op slices", n)
+    return tids
+
+
+def _optrace_events(events: list, tids: "_Tids", records,
+                    ops_filter=None) -> int:
+    """Per-op causal trace records as nested slices under the op
+    lifetimes: same pid/tid as the op's process track, so Perfetto
+    nests them by time containment. Spans (op/client/remote kinds)
+    become `X` slices carrying their attrs (cmd, node, exit, retries);
+    events become `i` instant markers."""
+    n = 0
+    for rec in records or []:
+        opi = rec.get("op")
+        if ops_filter is not None and opi not in ops_filter:
+            continue
+        if rec.get("process") is None or "t0" not in rec:
+            continue  # context-free events have no op track to sit on
+        kind = str(rec.get("kind", "span"))
+        # the op-kind record is the worker-side invoke nested inside
+        # the history's op-lifetime slice (cat "op") — name it apart
+        base = {"cat": "invoke" if kind == "op" else kind,
+                "name": str(rec.get("name", "?")),
+                "pid": _PID_CLIENTS,
+                "tid": tids.tid(str(rec["process"])),
+                "ts": _us(rec["t0"])}
+        args = {"trace": rec.get("trace"), "span": rec.get("span")}
+        if rec.get("status"):
+            args["status"] = str(rec["status"])
+        for k, v in (rec.get("attrs") or {}).items():
+            args[k] = v if isinstance(v, (int, float, str)) else repr(v)
+        base["args"] = args
+        if rec.get("kind") == "event":
+            base.update(ph="i", s="t")
+        else:
+            if "t1" not in rec:
+                continue
+            base.update(ph="X",
+                        dur=max(_us(rec["t1"] - rec["t0"]), 0.001))
+        events.append(base)
         n += 1
     return n
 
@@ -160,34 +212,105 @@ def _nemesis_events(events: list, test, history) -> int:
     return n
 
 
-def chrome_trace(test: dict | None, history, spans) -> dict:
+def expand_op_filter(history, ops) -> set | None:
+    """An anomaly's op references may be completion indices; the trace
+    and timeline join on invocation indices. Expands the given index
+    set so each index's pair is included too."""
+    if ops is None:
+        return None
+    if not isinstance(history, History):
+        history = History(history)
+    out = set(int(i) for i in ops)
+    for op in history:
+        if op.index in out:
+            try:
+                pair = (history.completion(op) if is_invoke(op)
+                        else history.invocation(op))
+            except KeyError:
+                pair = None
+            if pair is not None:
+                out.add(pair.index)
+    return out
+
+
+def chrome_trace(test: dict | None, history, spans,
+                 optrace=None, ops=None) -> dict:
     """The complete trace document for a run. `test` may be the loaded
     test.json dict (for nemesis plot specs), `history` a History or op
-    list, `spans` telemetry span records."""
+    list, `spans` telemetry span records, `optrace` per-op trace
+    records (jepsen_tpu.tracing). `ops`: restrict the client tracks to
+    these op indices — the pre-filtered anomaly drill-down view."""
+    history = history if history is not None else []
+    ops_filter = expand_op_filter(history, ops)
     events: list[dict] = []
     n_spans = _span_events(events, spans or [])
-    n_ops = _op_events(events, history if history is not None else [])
-    n_nem = _nemesis_events(events, test, history
-                            if history is not None else [])
-    logger.info("trace: %d spans, %d ops, %d nemesis windows",
-                n_spans, n_ops, n_nem)
+    tids = _op_events(events, history, ops_filter)
+    n_rec = _optrace_events(events, tids, optrace, ops_filter)
+    n_nem = _nemesis_events(events, test, history)
+    logger.info("trace: %d spans, %d optrace records, %d nemesis "
+                "windows", n_spans, n_rec, n_nem)
     return {"traceEvents": events,
             "displayTimeUnit": "ms",
             "otherData": {"source": "jepsen_tpu",
                           "test": str((test or {}).get("name"))}}
 
 
-def write_trace(run_dir, out_path=None) -> Path:
+def write_trace(run_dir, out_path=None, ops=None) -> Path:
     """Loads a stored run and writes its trace.json; returns the
     path. Works on runs that predate telemetry (spans just come back
-    empty) and on crashed runs (history read is torn-tolerant)."""
+    empty) and on crashed runs (history read is torn-tolerant). `ops`
+    pre-filters the client tracks to the given op indices (anomaly
+    provenance drill-down)."""
     from .. import store as jstore
 
     d = Path(run_dir)
     test = jstore.load(d)
     events, _metrics = jstore.load_telemetry(d)
-    doc = chrome_trace(test, test.get("history") or [], events)
+    optrace = jstore.load_optrace(d)
+    doc = chrome_trace(test, test.get("history") or [], events,
+                       optrace=optrace, ops=ops)
     out = Path(out_path) if out_path else d / TRACE_JSON
     with open(out, "w") as f:
         json.dump(doc, f)
     return out
+
+
+def validate_chrome_trace(doc: dict) -> int:
+    """Schema check for an exported Chrome-trace document: required
+    keys per event phase, non-negative microsecond timestamps and
+    durations, and metadata referential integrity (every slice's
+    pid/tid carries process_name/thread_name metadata). Returns the
+    event count; raises ValueError on the first violation."""
+    events = doc.get("traceEvents")
+    if not isinstance(events, list):
+        raise ValueError("traceEvents must be a list")
+    named_pids: set = set()
+    named_tids: set = set()
+    for i, ev in enumerate(events):
+        ph = ev.get("ph")
+        if ph not in ("X", "M", "i"):
+            raise ValueError(f"event {i}: unknown ph {ph!r}")
+        if "name" not in ev or "pid" not in ev:
+            raise ValueError(f"event {i}: missing name/pid: {ev}")
+        if ph == "M":
+            if not isinstance(ev.get("args"), dict):
+                raise ValueError(f"metadata event {i} missing args")
+            if ev["name"] == "process_name":
+                named_pids.add(ev["pid"])
+            elif ev["name"] == "thread_name":
+                named_tids.add((ev["pid"], ev["tid"]))
+            continue
+        ts = ev.get("ts")
+        if not isinstance(ts, (int, float)) or ts < 0:
+            raise ValueError(f"event {i}: bad ts {ts!r}")
+        if ph == "X":
+            dur = ev.get("dur")
+            if not isinstance(dur, (int, float)) or dur < 0:
+                raise ValueError(f"event {i}: bad dur {dur!r}")
+        if ev["pid"] not in named_pids:
+            raise ValueError(f"event {i}: pid {ev['pid']} unnamed")
+        if (ev["pid"], ev.get("tid")) not in named_tids:
+            raise ValueError(
+                f"event {i}: tid {ev.get('tid')} unnamed in pid "
+                f"{ev['pid']}")
+    return len(events)

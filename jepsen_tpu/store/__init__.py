@@ -15,6 +15,8 @@ Layout:
     metrics.json   aggregated span/counter/gauge metrics
     timeseries.jsonl  live-monitor sample points (jepsen_tpu.monitor),
                    appended while the run executes (web.py /live/ tails it)
+    optrace.jsonl  per-op causal trace: client/remote child spans +
+                   events (jepsen_tpu.tracing, when test["trace?"])
     trace.json     Chrome-trace/Perfetto export (reports/trace.py, on demand)
     <node>/...     downloaded node logs (core.snarf_logs)
   store/<name>/latest  -> most recent run   store/latest -> same
@@ -180,6 +182,14 @@ def load_telemetry(d) -> tuple[list, dict | None]:
     events = list(tel.read_events(d / tel.TRACE_FILE))
     metrics = tel.read_metrics(d / tel.METRICS_FILE)
     return events, metrics
+
+
+def load_optrace(d) -> list[dict]:
+    """Per-op trace records from a stored test dir's optrace.jsonl
+    (jepsen_tpu.tracing); [] when the run didn't opt into tracing."""
+    from .. import tracing as jtracing
+
+    return list(jtracing.read_records(Path(d) / jtracing.TRACE_FILE))
 
 
 def load_timeseries(d) -> list[dict]:

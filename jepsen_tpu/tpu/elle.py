@@ -455,6 +455,34 @@ def cycle_anomalies(n: int, edges, txns) -> dict[str, list]:
 
 
 # ---------------------------------------------------------------------------
+# Anomaly provenance
+# ---------------------------------------------------------------------------
+
+def annotate_op_indices(result: dict, hist) -> dict:
+    """Attaches the participating op (invocation) indices to every
+    anomaly record as rec['op-indices'] — the provenance link from a
+    verdict back to its traced ops (anomaly records usually carry
+    completion ops; checker.op_indices resolves them to the
+    invocation indices that trace records and timeline anchors join
+    on). reports/explain resolves these into per-anomaly trace
+    excerpts; web.py links them to pre-filtered Perfetto/timeline
+    views. Shared by both the host and device engines so the
+    differential tests stay engine-agnostic."""
+    from ..checker import op_indices
+
+    if not isinstance(hist, History):
+        hist = History(hist)
+    for recs in (result.get("anomalies") or {}).values():
+        for rec in recs:
+            if not isinstance(rec, dict) or "op-indices" in rec:
+                continue
+            ops = [rec.get(k) for k in ("op", "writer", "previous-ok")]
+            ops.extend(rec.get("cycle") or [])
+            rec["op-indices"] = op_indices(hist, *ops)
+    return result
+
+
+# ---------------------------------------------------------------------------
 # Public checks
 # ---------------------------------------------------------------------------
 
@@ -479,7 +507,8 @@ def check_list_append(hist, opts: dict | None = None) -> dict:
                               and len(hist) >= _DEVICE_MIN_OPS):
         from . import elle_device
         try:
-            return elle_device.check_list_append_device(hist)
+            return annotate_op_indices(
+                elle_device.check_list_append_device(hist), hist)
         except elle_device.Unvectorizable:
             if engine == "device":
                 raise
@@ -489,13 +518,13 @@ def check_list_append(hist, opts: dict | None = None) -> dict:
                                     a.txns).items():
         anomalies[name] = ws
     types = sorted(anomalies.keys())
-    return {
+    return annotate_op_indices({
         "valid?": not anomalies,
         "anomaly-types": types,
         "anomalies": {k: v[:8] for k, v in anomalies.items()},
         "edge-count": len(a.edges),
         "txn-count": len(a.txns),
-    }
+    }, hist)
 
 
 def check_rw_register(hist, opts: dict | None = None) -> dict:
@@ -520,7 +549,8 @@ def check_rw_register(hist, opts: dict | None = None) -> dict:
         from . import elle_device
 
         try:
-            return elle_device.check_rw_register_device(hist)
+            return annotate_op_indices(
+                elle_device.check_rw_register_device(hist), hist)
         except elle_device.Unvectorizable:
             pass  # host edge inference below; SCC still on device
     txns = collect(hist)
@@ -630,11 +660,11 @@ def check_rw_register(hist, opts: dict | None = None) -> dict:
         cyc = cycle_anomalies(len(txns), edges, txns)
     for name, ws in cyc.items():
         anomalies[name] = ws
-    return {
+    return annotate_op_indices({
         "valid?": not anomalies,
         "anomaly-types": sorted(anomalies.keys()),
         "anomalies": {k: v[:8] for k, v in anomalies.items()},
         "edge-count": n_edges,
         "txn-count": len(txns),
-    }
+    }, hist)
 

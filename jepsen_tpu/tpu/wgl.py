@@ -32,6 +32,7 @@ algorithm but re-shape it for SIMD:
 from __future__ import annotations
 
 import functools
+import logging
 import threading as _threading
 import time as _time
 from typing import Any, Sequence
@@ -45,6 +46,8 @@ from ..history import History
 from .encode import INF, Encoded, EncodingError, encode
 
 BIG = int(INF)
+
+logger = logging.getLogger(__name__)
 
 
 # ---------------------------------------------------------------------------
@@ -836,6 +839,18 @@ def check_segmented(enc: Encoded, target_len: int | None = None,
     rows fall back to the exact host search. Wrong start states die
     in the prefix, so the main launch runs ~half the rows."""
     if enc.n_states > 32:
+        # the per-(segment, state) reach masks are uint32 bitmasks; a
+        # bigger state space silently fell back to the whole-history
+        # path before — make the bail observable (telemetry counter +
+        # a warning naming the model), since the fallback forfeits
+        # segment-level checkpointing and anomaly localization
+        telemetry.count("wgl.segmented.fallback-states")
+        model_name = (type(enc.states[enc.init_state]).__name__
+                      if len(enc.states) else "?")
+        logger.warning(
+            "check_segmented: %s model has %d states (> 32, the "
+            "reach-mask width); falling back to the whole-history "
+            "search path", model_name, enc.n_states)
         return None
     if target_len is None:
         # Adaptive: long segments amortize kernel latency best (the
@@ -973,6 +988,33 @@ def check_segmented(enc: Encoded, target_len: int | None = None,
 SEGMENT_MIN_M = 4096
 
 
+def _witness_op_indices(out: dict) -> dict:
+    """Attaches the participating op (invocation) indices to an
+    invalid analysis as out['op-indices'] — anomaly provenance: the
+    stuck op, its predecessor, and every pending op in the surviving
+    configs. entry_ops are merged invocations, so the indices join the
+    per-op trace (optrace.jsonl) and timeline anchors directly."""
+    if out.get("valid?") is not False or "op-indices" in out:
+        return out
+    idxs = set()
+
+    def add(o):
+        i = getattr(o, "index", None)
+        if i is None and isinstance(o, dict):
+            i = o.get("index")
+        if isinstance(i, int) and i >= 0:
+            idxs.add(i)
+
+    add(out.get("op"))
+    add(out.get("previous-ok"))
+    for cfg in out.get("configs") or []:
+        for o in (cfg.get("pending") or []) if isinstance(cfg, dict) \
+                else []:
+            add(o)
+    out["op-indices"] = sorted(idxs)
+    return out
+
+
 def _seg_kwargs(W: int | None, F: int | None, **extra) -> dict:
     """check_segmented kwargs: only overrides the leaner segmented
     defaults (W=24/F=48) when the caller tuned W/F explicitly."""
@@ -1003,10 +1045,10 @@ def extract_witness(enc: Encoded, W: int | None = None,
         seg = check_segmented(enc, witness=True, **_seg_kwargs(W, F))
         if seg is not None:
             seg["witness-extraction"] = "segmented"
-            return seg
+            return _witness_op_indices(seg)
     out = search_host(enc, witness=True)
     out["witness-extraction"] = "host"
-    return out
+    return _witness_op_indices(out)
 
 
 def analysis(model, hist, algorithm: str = "tpu", W: int | None = None,
@@ -1027,16 +1069,16 @@ def analysis(model, hist, algorithm: str = "tpu", W: int | None = None,
     except EncodingError:
         out = search_host_model(model, hist, witness=True)
         out["analyzer"] = "model"
-        return out
+        return _witness_op_indices(out)
 
     if algorithm == "model":
         out = search_host_model(model, hist, witness=True)
         out["analyzer"] = "model"
-        return out
+        return _witness_op_indices(out)
     if algorithm == "wgl":
         out = search_host(enc, witness=True)
         out["analyzer"] = "wgl"
-        return out
+        return _witness_op_indices(out)
 
     # Long histories: segment-parallel path (one batched launch over
     # segments x start-states instead of m sequential frontier steps).
@@ -1052,7 +1094,7 @@ def analysis(model, hist, algorithm: str = "tpu", W: int | None = None,
         seg = check_segmented(enc, witness=True, **seg_kw)
         if seg is not None:
             seg["analyzer"] = "tpu-segmented"
-            return seg
+            return _witness_op_indices(seg)
 
     try:
         res = int(check_batch([enc],
@@ -1061,16 +1103,16 @@ def analysis(model, hist, algorithm: str = "tpu", W: int | None = None,
     except RangeError:
         out = search_host(enc, witness=True)
         out["analyzer"] = "wgl"
-        return out
+        return _witness_op_indices(out)
     if res == VALID:
         return {"valid?": True, "analyzer": "tpu"}
     if res == INVALID:
         out = search_host(enc, witness=True)  # witness extraction
         out["analyzer"] = "tpu"
-        return out
+        return _witness_op_indices(out)
     out = search_host(enc, witness=True)
     out["analyzer"] = "tpu+host-fallback"
-    return out
+    return _witness_op_indices(out)
 
 
 def analysis_batch_streamed(model, hists: Sequence, chunk: int = 256,
@@ -1097,7 +1139,7 @@ def analysis_batch_streamed(model, hists: Sequence, chunk: int = 256,
             except EncodingError:
                 out = search_host_model(model, hh, witness=True)
                 out["analyzer"] = "model"
-                results[i] = out
+                results[i] = _witness_op_indices(out)
         if not encs:
             return None
         try:

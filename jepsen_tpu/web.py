@@ -5,12 +5,16 @@ the store with cheap header reads (51-112), per-test file browser with
 a path-traversal guard (288-388), zip download of a test directory
 (340-381), app routes '/' and '/files/' (431-446).
 
-Beyond the reference: a `/telemetry/<run>` span/metrics page and a
+Beyond the reference: a `/telemetry/<run>` span/metrics page, a
 `/live/<run>` dashboard that streams an *in-progress* run over
 Server-Sent Events by tailing the live monitor's timeseries.jsonl
 (jepsen_tpu.monitor flushes each point, so the server — typically a
-separate process from the test — sees them as they land). `/live/`
-with no run path follows the store's `current` symlink.
+separate process from the test — sees them as they land; `/live/`
+with no run path follows the store's `current` symlink), and a
+`/trace/<run>?ops=...` endpoint serving the Chrome-trace/Perfetto
+JSON, optionally pre-filtered to an anomaly's participating ops — the
+run page lists each anomaly with such drill-down links (anomaly
+provenance, jepsen_tpu.tracing).
 """
 
 from __future__ import annotations
@@ -88,6 +92,64 @@ def home_html(base: Path | None = None) -> str:
             + "".join(rows) + "</table></body></html>")
 
 
+def anomaly_index(res, prefix: str = "", depth: int = 0) -> list:
+    """[(label, [op indices])] for every anomaly/counterexample in a
+    results map that carries provenance (`op-indices`, attached by the
+    elle/wgl/set checkers) — what the per-run page links to
+    pre-filtered trace and timeline views."""
+    out: list = []
+    if not isinstance(res, dict) or depth > 4:
+        return out
+    anomalies = res.get("anomalies")
+    if isinstance(anomalies, dict):
+        for name, recs in sorted(anomalies.items(), key=str):
+            idxs = sorted({int(i) for rec in recs
+                           if isinstance(rec, dict)
+                           for i in rec.get("op-indices") or []})
+            if idxs:
+                out.append((f"{prefix}{name}", idxs))
+    if res.get("valid?") is False and res.get("op-indices"):
+        out.append((f"{prefix}counterexample",
+                    sorted(int(i) for i in res["op-indices"])))
+    lost = res.get("lost-op-indices")
+    if isinstance(lost, dict):
+        idxs = sorted({int(i) for v in lost.values() for i in v})
+        if idxs:
+            out.append((f"{prefix}lost-elements", idxs))
+    for k, v in sorted(res.items(), key=lambda kv: str(kv[0])):
+        if isinstance(v, dict) and k not in ("anomalies",
+                                             "lost-op-indices"):
+            out.extend(anomaly_index(v, prefix=f"{k}/",
+                                     depth=depth + 1))
+    return out
+
+
+def _anomaly_html(rel: str, d: Path) -> str:
+    """The per-run anomaly-provenance section: each anomaly links to a
+    pre-filtered Perfetto export (/trace/<run>?ops=...) and to the
+    timeline anchored at its first participating op."""
+    try:
+        res = jstore.load_results(d)
+    except (OSError, json.JSONDecodeError):
+        res = None
+    links = anomaly_index(res) if res else []
+    if not links:
+        return ""
+    rows = []
+    for label, idxs in links[:32]:
+        qs = ",".join(str(i) for i in idxs[:64])
+        preview = ", ".join(str(i) for i in idxs[:8]) + (
+            "…" if len(idxs) > 8 else "")
+        rows.append(
+            f"<li><b>{_html.escape(label)}</b> (ops {preview}) — "
+            f"<a href='/trace/{_html.escape(rel)}?ops={qs}'>perfetto"
+            f"</a> · <a href='/files/{_html.escape(rel)}/timeline.html"
+            f"#op-{idxs[0]}'>timeline</a></li>")
+    return ("<h2>anomalies</h2><p>op references link to the traced "
+            "ops behind each anomaly</p><ul>" + "".join(rows)
+            + "</ul>")
+
+
 def dir_html(rel: str, d: Path) -> str:
     entries = sorted(d.iterdir(),
                      key=lambda p: (not p.is_dir(), p.name))
@@ -96,13 +158,16 @@ def dir_html(rel: str, d: Path) -> str:
         f"{'/' if e.is_dir() else ''}'>{_html.escape(e.name)}"
         f"{'/' if e.is_dir() else ''}</a></li>" for e in entries)
     views = ""
+    anomalies = ""
     if (d / "test.json").exists():
         # a run directory: link its rendered views next to the raw files
         run_rel = _html.escape(rel.rstrip("/"))
         views = (f"<p>views: <a href='/telemetry/{run_rel}'>telemetry"
-                 f"</a> · <a href='/live/{run_rel}'>live</a></p>")
+                 f"</a> · <a href='/live/{run_rel}'>live</a> · "
+                 f"<a href='/trace/{run_rel}'>perfetto json</a></p>")
+        anomalies = _anomaly_html(rel.rstrip("/"), d)
     return (f"<!DOCTYPE html><html><body><h1>{_html.escape(rel)}</h1>"
-            f"{views}<ul>{items}</ul></body></html>")
+            f"{views}{anomalies}<ul>{items}</ul></body></html>")
 
 
 def live_html(rel: str) -> str:
@@ -324,6 +389,26 @@ class StoreHandler(BaseHTTPRequestHandler):
                     self._sse_stream(d)
                 else:
                     self._send(200, live_html(rel).encode())
+            elif path.startswith("/trace/"):
+                rel = path[len("/trace/"):].rstrip("/")
+                p = self._resolve(rel)
+                if p is None or not p.is_dir():
+                    self._send(404, b"not found", "text/plain")
+                else:
+                    from .reports import trace as rtrace
+
+                    ops = None
+                    if query.get("ops"):
+                        ops = [int(x) for x in query["ops"][0].split(",")
+                               if x.strip().lstrip("-").isdigit()]
+                    test = jstore.load(p)
+                    events, _m = jstore.load_telemetry(p)
+                    optrace = jstore.load_optrace(p)
+                    doc = rtrace.chrome_trace(
+                        test, test.get("history") or [], events,
+                        optrace=optrace, ops=ops)
+                    self._send(200, json.dumps(doc).encode(),
+                               "application/json")
             elif path.startswith("/zip/"):
                 rel = path[len("/zip/"):].rstrip("/")
                 p = self._resolve(rel)

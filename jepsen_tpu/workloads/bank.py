@@ -137,6 +137,7 @@ def checker(opts: dict | None = None) -> chk.Checker:
 
 def workload(opts: dict | None = None) -> dict:
     from .. import generator as gen
+    from ..reports.perf import balance_graph
 
     o = dict(opts or {})
     accounts = o.get("accounts", list(range(8)))
@@ -148,6 +149,9 @@ def workload(opts: dict | None = None) -> dict:
         "total-amount": o.get("total-amount",
                               len(accounts) * o.get("initial", 10)),
         "generator": g,
+        # the balance-over-time plot rides next to the conservation
+        # verdict (bank.clj:150-176's plot entry in the bundle)
         "checker": chk.compose({"bank": checker(o),
+                                "balance-plot": balance_graph(),
                                 "stats": chk.stats()}),
     }

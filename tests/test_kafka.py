@@ -254,6 +254,60 @@ class TestEndToEnd:
         assert "generator" in w and "checker" in w
 
 
+def assign_ok(p, keys):
+    return (("invoke", p, "assign", keys), ("ok", p, "assign", keys))
+
+
+class TestAssignMode:
+    """ISSUE-4 satellite (VERDICT weak #5): the sub-via consumer
+    policy (kafka.clj:2019-2046 — poll-skip/nonmonotonic-poll are
+    legal under subscribe, errors under assign) and the assignment
+    reset branch in Analysis._contiguity, exercised through an
+    explicit assign-mode history."""
+
+    def _skip_history(self, mid=None):
+        """Consumer 1 polls offset-rank 0 then rank 2 (an external
+        poll skip); consumer 2 drains everything so no lost/unseen
+        noise muddies the verdict. `mid` rides between consumer 1's
+        polls."""
+        pairs = [send_ok(0, 0, 0, 1), send_ok(0, 0, 1, 2),
+                 send_ok(0, 0, 2, 3),
+                 assign_ok(1, [0]),
+                 poll_ok(1, {0: [[0, 1]]})]
+        if mid is not None:
+            pairs.append(mid)
+        pairs += [poll_ok(1, {0: [[2, 3]]}),
+                  assign_ok(2, [0]),
+                  poll_ok(2, {0: [[0, 1], [1, 2], [2, 3]]})]
+        return flat(*pairs)
+
+    def test_poll_skip_allowed_under_subscribe(self):
+        res = kafka.check(self._skip_history())
+        assert "poll-skip" in res["error-types"], res
+        assert res["valid?"] is True, res
+
+    def test_poll_skip_flagged_in_assign_mode(self):
+        res = kafka.check(self._skip_history(),
+                          {"sub-via": ("assign",)})
+        assert res["valid?"] is False
+        assert "poll-skip" in res["bad-error-types"], res
+
+    def test_reassign_resets_external_poll_tracking(self):
+        # an ok re-assign between the polls legitimately moves the
+        # consumer (kafka.py _contiguity's reset branch): no skip,
+        # even in assign mode
+        res = kafka.check(self._skip_history(mid=assign_ok(1, [0])),
+                          {"sub-via": ("assign",)})
+        assert "poll-skip" not in res["error-types"], res
+        assert res["valid?"] is True, res
+
+    def test_checker_reads_sub_via_from_test_map(self):
+        c = kafka.checker()
+        res = c.check({"sub-via": ("assign",)}, self._skip_history(),
+                      {})
+        assert "poll-skip" in res["bad-error-types"], res
+
+
 class TestReviewRegressions:
     def test_info_send_offsets_count(self):
         """An indeterminate send that still reports its offset must

@@ -79,6 +79,57 @@ def test_perf_checker_writes_artifacts(tmp_path):
         assert os.path.getsize(f) > 1000
 
 
+def bank_history():
+    """Transfers + reads over 3 accounts, balances conserved."""
+    events = []
+    bal = {0: 10, 1: 10, 2: 10}
+    i = 0
+    for t in range(12):
+        if t % 3 == 2:
+            frm, to = t % 2, (t % 2) + 1
+            bal[frm] -= 1
+            bal[to] += 1
+            v = {"from": frm, "to": to, "amount": 1}
+            events.append(op(index=i, time=sec(t), type="invoke",
+                             process=0, f="transfer", value=v))
+            events.append(op(index=i + 1, time=sec(t) + int(1e8),
+                             type="ok", process=0, f="transfer",
+                             value=v))
+        else:
+            events.append(op(index=i, time=sec(t), type="invoke",
+                             process=1, f="read", value=None))
+            events.append(op(index=i + 1, time=sec(t) + int(1e8),
+                             type="ok", process=1, f="read",
+                             value=dict(bal)))
+        i += 2
+    return History(events, assign_indices=False)
+
+
+def test_bank_balance_plot_renders(tmp_path):
+    """ISSUE-4 satellite: the bank workload's balance-over-time plot
+    (bank.clj:150-176 analog) renders into the store dir."""
+    from jepsen_tpu.workloads import bank
+
+    test = {"name": "bank-plot", "store_dir": str(tmp_path),
+            "nodes": ["n1"], "total-amount": 30}
+    w = bank.workload({"total-amount": 30})
+    res = checker.check_safe(w["checker"], test, bank_history())
+    assert res["valid?"] is True, res
+    files = res["balance-plot"]["files"]
+    assert [f.split("/")[-1] for f in files] == ["bank-balances.png"]
+    import os
+    assert os.path.getsize(files[0]) > 1000
+    # and the conservation verdict still rides alongside
+    assert res["bank"]["valid?"] is True
+
+
+def test_bank_balance_plot_skips_without_reads(tmp_path):
+    test = {"name": "bank-plot-empty", "store_dir": str(tmp_path)}
+    res = checker.check_safe(perf_mod.balance_graph(), test,
+                             History([]))
+    assert res["valid?"] is True and res["files"] == []
+
+
 def test_perf_checker_skips_without_store():
     res = checker.check_safe(checker.perf(), {"nodes": []},
                              register_history())

@@ -1,0 +1,605 @@
+"""Per-op causal tracing: recorder semantics, schema validation for
+optrace.jsonl and the Chrome-trace export, propagation through the
+interpreter/client/control layers, and the anomaly-provenance loop
+(op-indices -> explain excerpts -> pre-filtered trace views)."""
+
+import json
+import random
+import threading
+
+import pytest
+
+from jepsen_tpu import checker as jchecker
+from jepsen_tpu import client as jclient
+from jepsen_tpu import control, core, interpreter, testing, tracing, util
+from jepsen_tpu import generator as gen
+from jepsen_tpu import store as jstore
+from jepsen_tpu.control.core import (Action, Result, TransportError)
+from jepsen_tpu.history import History, Op, op
+from jepsen_tpu.reports import explain, timeline
+from jepsen_tpu.reports import trace as rtrace
+from jepsen_tpu.tpu import elle
+from jepsen_tpu.workloads import register as register_wl
+
+
+def _op(i, f="write", p=0):
+    return Op(index=i, time=i, type="invoke", process=p, f=f, value=1)
+
+
+# ---------------------------------------------------------------------------
+# Recorder semantics
+# ---------------------------------------------------------------------------
+
+class TestTracer:
+    def test_disabled_records_nothing(self):
+        tr = tracing.Tracer(enabled=False)
+        with tr.op_span(_op(0)) as rec:
+            assert rec is None
+            with tr.span("client", "client.write") as c:
+                assert c is None
+            tr.event("reconnect")
+        assert tr.records() == []
+
+    def test_op_span_mints_trace_context(self):
+        tr = tracing.Tracer(enabled=True)
+        with tr.op_span(_op(7, f="cas")) as rec:
+            assert rec["trace"] == 7 and rec["op"] == 7
+            assert rec["parent"] is None and rec["kind"] == "op"
+            with tr.span("client", "client.cas") as c:
+                assert c["trace"] == 7 and c["parent"] == rec["span"]
+                with tr.span("remote", "remote.sh", cmd="sh x") as r:
+                    assert r["parent"] == c["span"]
+        kinds = [r["kind"] for r in tr.records()]
+        assert kinds == ["remote", "client", "op"]  # close order
+        tracing.validate_records(tr.records())
+
+    def test_span_without_context_is_noop(self):
+        tr = tracing.Tracer(enabled=True)
+        with tr.span("remote", "remote.sh") as rec:
+            assert rec is None
+        assert tr.records() == []
+
+    def test_event_with_and_without_context(self):
+        tr = tracing.Tracer(enabled=True)
+        tr.event("net.heal")  # setup-time event: context-free
+        with tr.op_span(_op(3)):
+            tr.event("reconnect", error="boom")
+        recs = tr.records()
+        assert recs[0]["trace"] is None and recs[0]["parent"] is None
+        assert recs[1]["trace"] == 3 and recs[1]["parent"] is not None
+        tracing.validate_records(recs)
+
+    def test_annotate_hits_innermost_span(self):
+        tr = tracing.Tracer(enabled=True)
+        with tr.op_span(_op(0)):
+            with tr.span("remote", "remote.sh"):
+                tr.annotate(retries=2)
+        remote = [r for r in tr.records() if r["kind"] == "remote"][0]
+        assert remote["attrs"]["retries"] == 2
+
+    def test_crashed_invoke_marks_status(self):
+        tr = tracing.Tracer(enabled=True)
+        with pytest.raises(RuntimeError):
+            with tr.op_span(_op(0)):
+                raise RuntimeError("client died")
+        rec = tr.records()[0]
+        assert rec["status"] == "crashed" and "t1" in rec
+
+    def test_attach_carries_context_across_threads(self):
+        tr = tracing.Tracer(enabled=True)
+        with tr.op_span(_op(5)) as rec:
+            def body():
+                with tr.attach(rec):
+                    with tr.span("remote", "remote.echo"):
+                        pass
+            t = threading.Thread(target=body)
+            t.start()
+            t.join()
+        remote = [r for r in tr.records() if r["kind"] == "remote"][0]
+        assert remote["trace"] == 5 and remote["parent"] == rec["span"]
+        tracing.validate_records(tr.records())
+
+    def test_straggler_span_from_before_reset_is_dropped(self):
+        """A worker thread surviving an abnormal interpreter exit
+        closes its span AFTER the next run reset the tracer: the
+        record must not leak into the new run (its span id would
+        collide with the restarted counter)."""
+        tr = tracing.Tracer(enabled=True)
+        cm = tr.op_span(_op(0))
+        cm.__enter__()          # run A opens a span...
+        tr.reset(enabled=True)  # ...run B resets the tracer
+        with tr.op_span(_op(1)):
+            pass
+        cm.__exit__(None, None, None)  # run A's straggler closes
+        recs = tr.records()
+        assert [r["trace"] for r in recs] == [1]
+        tracing.validate_records(recs)
+
+    def test_streaming_and_readback(self, tmp_path):
+        tr = tracing.Tracer(enabled=True)
+        tr.open(tmp_path / tracing.TRACE_FILE)
+        with tr.op_span(_op(0)):
+            tr.event("net.drop", src="n1", dest="n2")
+        tr.close()
+        recs = list(tracing.read_records(tmp_path / tracing.TRACE_FILE))
+        assert len(recs) == 2
+        assert tracing.validate_records(recs) == 2
+
+    def test_torn_tail_dropped_on_read(self, tmp_path):
+        tr = tracing.Tracer(enabled=True)
+        with tr.op_span(_op(0)):
+            pass
+        p = tr.save(tmp_path)
+        with open(p, "a") as f:
+            f.write('{"torn": ')
+        recs = list(tracing.read_records(p))
+        assert len(recs) == 1
+
+
+class TestValidation:
+    def _good(self):
+        return [{"trace": 0, "span": 1, "parent": None, "kind": "op",
+                 "name": "write", "op": 0, "process": "0",
+                 "t0": 10, "t1": 30},
+                {"trace": 0, "span": 2, "parent": 1, "kind": "client",
+                 "name": "client.write", "op": 0, "process": "0",
+                 "t0": 12, "t1": 25}]
+
+    def test_good_records_pass(self):
+        assert tracing.validate_records(self._good()) == 2
+
+    def test_missing_key_rejected(self):
+        recs = self._good()
+        del recs[0]["t1"]
+        with pytest.raises(ValueError, match="missing 't1'"):
+            tracing.validate_records(recs)
+
+    def test_non_monotonic_ts_rejected(self):
+        recs = self._good()
+        recs[1]["t1"] = 5
+        with pytest.raises(ValueError, match="non-monotonic"):
+            tracing.validate_records(recs)
+
+    def test_dangling_parent_rejected(self):
+        recs = self._good()
+        recs[1]["parent"] = 99
+        with pytest.raises(ValueError, match="parent 99"):
+            tracing.validate_records(recs)
+
+    def test_duplicate_span_id_rejected(self):
+        recs = self._good()
+        recs[1]["span"] = 1
+        with pytest.raises(ValueError, match="duplicate"):
+            tracing.validate_records(recs)
+
+    def test_cross_trace_parent_rejected(self):
+        recs = self._good()
+        recs[1]["trace"] = 3
+        with pytest.raises(ValueError, match="another trace"):
+            tracing.validate_records(recs)
+
+    def test_unknown_kind_rejected(self):
+        recs = self._good()
+        recs[0]["kind"] = "mystery"
+        with pytest.raises(ValueError, match="unknown kind"):
+            tracing.validate_records(recs)
+
+
+# ---------------------------------------------------------------------------
+# Propagation: control/retry/reconnect/net layers
+# ---------------------------------------------------------------------------
+
+class TestControlPropagation:
+    def test_exec_records_remote_span(self):
+        tr = tracing.get()
+        tr.reset(enabled=True)
+        try:
+            test = {"ssh": {"dummy": True}}
+            with tr.op_span(_op(0)):
+                with control.with_session(test, "n1"):
+                    control.exec_("echo", "hello")
+            remote = [r for r in tr.records()
+                      if r["kind"] == "remote"]
+            assert len(remote) == 1
+            rec = remote[0]
+            assert rec["name"] == "remote.echo"
+            assert rec["attrs"]["cmd"] == "echo hello"
+            assert rec["attrs"]["node"] == "n1"
+            assert rec["attrs"]["exit"] == 0
+            tracing.validate_records(tr.records())
+        finally:
+            tr.reset(enabled=False)
+
+    def test_on_nodes_carries_context_to_pool_threads(self):
+        tr = tracing.get()
+        tr.reset(enabled=True)
+        try:
+            test = {"ssh": {"dummy": True}, "nodes": ["n1", "n2"]}
+            with tr.op_span(_op(4, f="start", p="nemesis")):
+                control.on_nodes(
+                    test, lambda t, n: control.exec_("date"))
+            remote = [r for r in tr.records() if r["kind"] == "remote"]
+            assert len(remote) == 2
+            assert all(r["trace"] == 4 for r in remote)
+            assert {r["attrs"]["node"] for r in remote} == {"n1", "n2"}
+        finally:
+            tr.reset(enabled=False)
+
+    def test_retry_count_lands_on_span(self):
+        from jepsen_tpu.control.retry import RetryingRemote
+
+        calls = [0]
+
+        class FlakyRemote(control.Remote):
+            def connect(self, conn_spec):
+                class S(control.Session):
+                    def execute(self, action):
+                        calls[0] += 1
+                        if calls[0] < 3:
+                            raise TransportError(
+                                "flaky", cmd=action.cmd, node="n1")
+                        return Result(exit=0, out="", err="",
+                                      cmd=action.cmd)
+
+                    def disconnect(self):
+                        pass
+
+                return S()
+
+        tr = tracing.get()
+        tr.reset(enabled=True)
+        try:
+            sess = RetryingRemote(FlakyRemote()).connect({"host": "n1"})
+            with tr.op_span(_op(0)):
+                res = control.core.traced_execute(
+                    sess, Action(cmd="echo hi"), node="n1")
+            assert res.exit == 0 and calls[0] == 3
+            recs = tr.records()
+            remote = [r for r in recs if r["kind"] == "remote"][0]
+            assert remote["attrs"]["retries"] == 2
+            retries = [r for r in recs if r["kind"] == "event"
+                       and r["name"] == "remote-retry"]
+            assert len(retries) == 2
+            assert all(r["trace"] == 0 for r in retries)
+            tracing.validate_records(recs)
+        finally:
+            tr.reset(enabled=False)
+
+    def test_reconnect_records_event(self):
+        from jepsen_tpu import reconnect
+
+        tr = tracing.get()
+        tr.reset(enabled=True)
+        try:
+            w = reconnect.Wrapper(open=lambda: object(),
+                                  close=lambda c: None, name="db")
+            with tr.op_span(_op(2)):
+                with pytest.raises(RuntimeError):
+                    with w.with_conn():
+                        raise RuntimeError("conn died")
+            evs = [r for r in tr.records() if r["kind"] == "event"]
+            assert len(evs) == 1 and evs[0]["name"] == "reconnect"
+            assert evs[0]["trace"] == 2
+        finally:
+            tr.reset(enabled=False)
+
+    def test_partition_records_net_events(self):
+        from jepsen_tpu import net
+
+        tr = tracing.get()
+        tr.reset(enabled=True)
+        try:
+            test = {"ssh": {"dummy": True}, "nodes": ["n1", "n2"],
+                    "sessions": {}}
+            with tr.op_span(_op(9, f="start", p="nemesis")):
+                net.iptables.heal(test)
+            evs = [r for r in tr.records() if r["kind"] == "event"]
+            assert any(r["name"] == "net.heal" and r["trace"] == 9
+                       for r in evs)
+        finally:
+            tr.reset(enabled=False)
+
+
+# ---------------------------------------------------------------------------
+# Pipeline: interpreter + core.run
+# ---------------------------------------------------------------------------
+
+def _register_test(tmp_path, name, n=40, **kw):
+    state = testing.AtomState()
+    rng = random.Random(7)
+    t = testing.noop_test()
+    t.update(
+        name=name, store_base=str(tmp_path), nodes=["n1", "n2"],
+        concurrency=4, monitor_interval_s=0.05,
+        client=testing.AtomClient(state),
+        checker=jchecker.stats(),
+        generator=gen.clients(gen.limit(
+            n, lambda: register_wl.cas_op_mix(rng, n_values=3))))
+    t.update(kw)
+    return t
+
+
+class TestPipeline:
+    def test_traced_run_streams_valid_optrace(self, tmp_path):
+        test = _register_test(tmp_path, "trace-e2e", **{"trace?": True})
+        test = core.run(test)
+        assert test["results"]["valid?"] is True
+        d = jstore.path(test)
+        recs = jstore.load_optrace(d)
+        assert tracing.validate_records(recs) == len(recs)
+        ops = [r for r in recs if r["kind"] == "op"]
+        clients = [r for r in recs if r["kind"] == "client"]
+        # every client invocation got an op span + a client child span
+        invokes = [o for o in test["history"] if o.type == "invoke"]
+        assert len(ops) == len(invokes)
+        assert len(clients) >= len(invokes)
+        assert {r["status"] for r in ops} <= {"ok", "fail", "info"}
+        # trace ids join the history: each op record names a real
+        # invocation with the same f
+        by_index = {o.index: o for o in test["history"]}
+        for r in ops:
+            assert by_index[r["op"]].f == r["name"]
+
+    def test_untraced_run_writes_no_optrace(self, tmp_path):
+        test = core.run(_register_test(tmp_path, "untraced"))
+        d = jstore.path(test)
+        assert not (d / tracing.TRACE_FILE).exists()
+        assert jstore.load_optrace(d) == []
+
+    def test_trace_clients_opt_out(self, tmp_path):
+        test = _register_test(tmp_path, "no-client-spans",
+                              **{"trace?": True,
+                                 "trace_clients?": False})
+        test = core.run(test)
+        recs = jstore.load_optrace(jstore.path(test))
+        kinds = {r["kind"] for r in recs}
+        assert "op" in kinds and "client" not in kinds
+
+    def test_exported_chrome_trace_validates_and_nests(self, tmp_path):
+        test = core.run(_register_test(tmp_path, "trace-export",
+                                       **{"trace?": True}))
+        d = jstore.path(test)
+        out = rtrace.write_trace(d)
+        with open(out) as f:
+            doc = json.load(f)
+        rtrace.validate_chrome_trace(doc)
+        evs = doc["traceEvents"]
+        cats = {e.get("cat") for e in evs}
+        assert {"op", "invoke", "client"} <= cats
+        # client child slices sit on the same track as their op slice
+        # and inside its time range
+        op_slices = {}
+        for e in evs:
+            if e.get("cat") == "op":
+                op_slices.setdefault(e["tid"], []).append(e)
+        checked = 0
+        for e in evs:
+            if e.get("cat") != "client":
+                continue
+            hosts = [o for o in op_slices.get(e["tid"], [])
+                     if o["ts"] <= e["ts"]
+                     and e["ts"] + e["dur"] <= o["ts"] + o["dur"] + 1e-3]
+            assert hosts, f"client slice {e} has no enclosing op slice"
+            checked += 1
+        assert checked > 0
+
+    def test_ops_filter_restricts_client_tracks(self, tmp_path):
+        test = core.run(_register_test(tmp_path, "trace-filter",
+                                       **{"trace?": True}))
+        d = jstore.path(test)
+        full = json.load(open(rtrace.write_trace(d)))
+        some_invoke = next(o for o in test["history"]
+                           if o.type == "invoke")
+        filt = json.load(open(rtrace.write_trace(
+            d, out_path=d / "trace-filtered.json",
+            ops=[some_invoke.index])))
+        rtrace.validate_chrome_trace(filt)
+
+        def op_count(doc):
+            return sum(1 for e in doc["traceEvents"]
+                       if e.get("cat") == "op")
+
+        assert op_count(filt) == 1 < op_count(full)
+
+    def test_timeline_hover_carries_trace_detail(self, tmp_path):
+        test = _register_test(tmp_path, "trace-timeline",
+                              **{"trace?": True})
+        test["checker"] = jchecker.compose({
+            "stats": jchecker.stats(),
+            "timeline": jchecker.timeline()})
+        test = core.run(test)
+        html = (jstore.path(test) / "timeline.html").read_text()
+        assert "— trace —" in html
+        assert "client client." in html
+
+
+# ---------------------------------------------------------------------------
+# Anomaly provenance
+# ---------------------------------------------------------------------------
+
+def _g1a_history():
+    """A failed append observed by a later read: G1a, with the ops at
+    known indices."""
+    return History([
+        Op(0, 10, "invoke", 0, "txn", [["append", "x", 1]]),
+        Op(1, 20, "fail", 0, "txn", [["append", "x", 1]]),
+        Op(2, 30, "invoke", 1, "txn", [["r", "x", None]]),
+        Op(3, 40, "ok", 1, "txn", [["r", "x", [1]]]),
+    ], assign_indices=False)
+
+
+class TestProvenance:
+    def test_elle_attaches_invocation_indices(self):
+        res = elle.check_list_append(_g1a_history(), {"engine": "host"})
+        assert res["valid?"] is False
+        rec = res["anomalies"]["G1a"][0]
+        # writer (completion index 1) resolves to invocation 0; the
+        # reading txn (completion 3) to invocation 2
+        assert rec["op-indices"] == [0, 2]
+
+    def test_wgl_witness_attaches_indices(self):
+        from jepsen_tpu.checker import models
+        from jepsen_tpu.tpu import wgl
+
+        hist = History([
+            op(type="invoke", process=0, f="write", value=1),
+            op(type="ok", process=0, f="write", value=1),
+            op(type="invoke", process=1, f="read", value=None),
+            op(type="ok", process=1, f="read", value=2),
+        ])
+        out = wgl.analysis(models.cas_register(), hist,
+                           algorithm="wgl")
+        assert out["valid?"] is False
+        assert out["op-indices"], out
+        assert all(isinstance(i, int) for i in out["op-indices"])
+
+    def test_set_full_lost_elements_carry_indices(self):
+        hist = History([
+            op(type="invoke", process=0, f="add", value=1),
+            op(type="ok", process=0, f="add", value=1),
+            op(type="invoke", process=1, f="read", value=None),
+            op(type="ok", process=1, f="read", value=[1]),
+            op(type="invoke", process=1, f="read", value=None),
+            op(type="ok", process=1, f="read", value=[]),
+        ])
+        res = jchecker.set_full().check({}, hist, {})
+        assert res["valid?"] is False and res["lost"] == [1]
+        assert res["lost-op-indices"][1] == [0, 4]
+
+    def _traced_records_for(self, indices):
+        tr = tracing.Tracer(enabled=True)
+        for i in indices:
+            o = Op(index=i, time=i, type="invoke", process=0, f="txn",
+                   value=None)
+            with tr.op_span(o):
+                with tr.span("remote", "remote.sh",
+                             cmd="sh -c probe", node="n1") as r:
+                    r["attrs"]["exit"] = 0
+        return tr.records()
+
+    def test_explain_excerpts_resolve_anomaly_ops(self, tmp_path):
+        """ISSUE-4 acceptance: a failed elle check yields anomalies
+        whose op references resolve to trace excerpts in the explain
+        output."""
+        res = elle.check_list_append(_g1a_history(), {"engine": "host"})
+        recs = self._traced_records_for([0, 2])
+        paths = explain.write_trace_excerpts(tmp_path, res,
+                                             optrace=recs)
+        assert len(paths) == 1 and "G1a-trace" in paths[0]
+        body = open(paths[0]).read()
+        assert "op 0:" in body and "op 2:" in body
+        assert "remote remote.sh" in body and "exit=0" in body
+
+    def test_linear_counterexample_excerpt(self, tmp_path):
+        from jepsen_tpu.checker import models
+
+        hist = History([
+            op(type="invoke", process=0, f="write", value=1),
+            op(type="ok", process=0, f="write", value=1),
+            op(type="invoke", process=1, f="read", value=None),
+            op(type="ok", process=1, f="read", value=2),
+        ])
+        test = {"store_dir": str(tmp_path)}
+        # pre-seed the optrace artifact the checker resolves against
+        tr = tracing.Tracer(enabled=True)
+        for o in hist:
+            if o.type == "invoke":
+                with tr.op_span(o):
+                    pass
+        tr.save(tmp_path)
+        out = jchecker.linearizable(
+            {"model": models.cas_register(),
+             "algorithm": "wgl"}).check(test, hist, {})
+        assert out["valid?"] is False
+        assert out.get("trace-excerpt")
+        body = open(out["trace-excerpt"]).read()
+        assert "participating ops" in body and "op read" in body
+
+    def test_seeded_failure_resolves_end_to_end(self, tmp_path):
+        """ISSUE-4 acceptance, full loop: a traced run with a seeded
+        linearizability violation yields a counterexample whose op
+        references resolve to a trace excerpt in the store dir AND to
+        client child spans in the (pre-filtered) Perfetto export."""
+        from jepsen_tpu.checker import models
+
+        state = testing.AtomState()
+
+        class CorruptingClient(jclient.Client):
+            """Flips one mid-run read to an impossible value."""
+
+            def __init__(self):
+                self.inner = testing.AtomClient(state)
+                self.reads = [0]
+
+            def open(self, test, node):
+                return self
+
+            def invoke(self, test, op_):
+                out = self.inner.invoke(test, op_)
+                if op_.f == "read" and out.type == "ok":
+                    self.reads[0] += 1
+                    if self.reads[0] == 5:
+                        return out.copy(value=999)
+                return out
+
+        test = _register_test(tmp_path, "provenance-e2e", n=30,
+                              **{"trace?": True})
+        test["client"] = CorruptingClient()
+        test["checker"] = jchecker.compose({
+            "stats": jchecker.stats(),
+            "linear": jchecker.linearizable(
+                {"model": models.cas_register(),
+                 "algorithm": "wgl"})})
+        test = core.run(test)
+        res = test["results"]["linear"]
+        assert res["valid?"] is False
+        idxs = res["op-indices"]
+        assert idxs
+        d = jstore.path(test)
+        # 1. trace excerpt written and naming the participating ops
+        body = open(res["trace-excerpt"]).read()
+        assert f"op {idxs[0]}:" in body and "client client." in body
+        # 2. pre-filtered Perfetto export carries those ops' child
+        # client spans
+        doc = json.load(open(rtrace.write_trace(
+            d, out_path=d / "trace-anomaly.json", ops=idxs)))
+        rtrace.validate_chrome_trace(doc)
+        traces = {e["args"].get("trace") for e in doc["traceEvents"]
+                  if e.get("cat") == "client"}
+        assert traces and traces <= set(idxs)
+        # 3. the run page links the anomaly to both views
+        from jepsen_tpu import web
+
+        rel = f"provenance-e2e/{d.name}"
+        html = web.dir_html(rel + "/", d)
+        assert f"#op-{idxs[0]}" in html and "?ops=" in html
+
+    def test_web_anomaly_index(self):
+        from jepsen_tpu import web
+
+        res = {"valid?": False,
+               "workload": {
+                   "valid?": False,
+                   "anomalies": {"G1a": [{"op-indices": [0, 2]}],
+                                 "G0": [{}]}},
+               "linear": {"valid?": False, "op-indices": [5, 7]},
+               "stats": {"valid?": True}}
+        idx = dict(web.anomaly_index(res))
+        assert idx["workload/G1a"] == [0, 2]
+        assert idx["linear/counterexample"] == [5, 7]
+        assert "workload/G0" not in idx  # no provenance, no link
+
+    def test_run_page_links_anomalies(self, tmp_path):
+        from jepsen_tpu import web
+
+        d = tmp_path / "t" / "20260101T000000.0000"
+        d.mkdir(parents=True)
+        (d / "test.json").write_text("{}")
+        (d / "results.json").write_text(json.dumps(
+            {"valid?": False,
+             "workload": {"valid?": False,
+                          "anomalies": {
+                              "G1a": [{"op-indices": [0, 2]}]}}}))
+        html = web.dir_html("t/20260101T000000.0000/", d)
+        assert "?ops=0,2" in html
+        assert "timeline.html#op-0" in html
