@@ -482,6 +482,110 @@ def bench_watchdog_latency(n_ops=200_000):
     }
 
 
+def bench_fallback_overhead(n_hists=64, ops_each=300):
+    """Degradation-ladder floor cost (ISSUE 5): the same ensemble
+    checked on the device vs with the device FORCED DOWN — every kernel
+    launch raises RESOURCE_EXHAUSTED, so analysis walks the ladder
+    (batch-halve -> width-halve -> host floor). Verdict parity between
+    the two passes is asserted; vs_baseline = device_time / forced_host
+    _time (the fraction of normal speed a dead device leaves you)."""
+    import statistics as _st
+
+    from jepsen_tpu.checker import models
+    from jepsen_tpu.tpu import synth, wgl
+
+    hists = [synth.register_history(ops_each, n_procs=4,
+                                    seed=2000 + i, crash_p=0.1)
+             for i in range(n_hists)]
+    total_ops = sum(len(h) for h in hists)
+    model = models.cas_register()
+    wgl.analysis_batch_streamed(model, hists, chunk=32)  # warm
+    dev_times = []
+    for _ in range(3):
+        t0 = time.time()
+        dev_res = wgl.analysis_batch_streamed(model, hists, chunk=32)
+        dev_times.append(time.time() - t0)
+    dev = _st.median(dev_times)
+
+    def boom(*a, **kw):
+        raise RuntimeError("RESOURCE_EXHAUSTED: bench-forced "
+                           "device failure")
+
+    orig = wgl._launch
+    wgl._launch = boom
+    try:
+        host_times = []
+        for _ in range(3):
+            t0 = time.time()
+            host_res = wgl.analysis_batch_streamed(model, hists,
+                                                   chunk=32)
+            host_times.append(time.time() - t0)
+    finally:
+        wgl._launch = orig
+    host_s = _st.median(host_times)
+    mismatches = sum(1 for a, b in zip(dev_res, host_res)
+                     if a["valid?"] != b["valid?"])
+    assert mismatches == 0, f"{mismatches} verdicts changed on fallback"
+    assert all("degradation" in r for r in host_res)
+    _log(f"fallback-overhead: device {dev:.2f}s forced-host "
+         f"{host_s:.2f}s ({host_s / dev:.1f}x slower), verdict parity "
+         f"{n_hists}/{n_hists}")
+    return {
+        "metric": f"forced-host degradation-ladder throughput "
+                  f"({n_hists} histories, verdict parity asserted)",
+        "value": round(total_ops / host_s, 1),
+        "unit": "ops/s",
+        "vs_baseline": round(dev / host_s, 3),
+    }
+
+
+def bench_analyze_resume(n_ops=2000):
+    """analyze --resume wall time (ISSUE 5): a stored run re-analyzed
+    offline, resumed vs from scratch. vs_baseline = fresh_time /
+    resume_time (>1 = resuming beats re-analyzing)."""
+    import statistics as _st
+    import tempfile
+
+    from jepsen_tpu import checker, core, resume, store, testing
+    from jepsen_tpu import generator as gen
+
+    with tempfile.TemporaryDirectory() as td:
+        state = testing.AtomState()
+        test = testing.noop_test()
+        test.update(
+            name="bench-resume", store_base=td, nodes=["n1", "n2"],
+            concurrency=4, db=testing.AtomDB(state),
+            client=testing.AtomClient(state, latency_s=0.0),
+            checker=checker.compose({"stats": checker.stats()}),
+            spec={"workload": "register",
+                  "opts": {"workload": "register",
+                           "nodes": ["n1", "n2"], "concurrency": 4,
+                           "ssh": {"dummy": True}, "ops": n_ops,
+                           "rate": 1e9, "time_limit": 60}},
+            generator=gen.clients(gen.limit(n_ops,
+                                            lambda: {"f": "read"})))
+        test = core.run(test)
+        d = store.path(test)
+        fresh = _st.median([_timed(lambda: resume.analyze_run(
+            d, resume=False)) for _ in range(3)])
+        resumed = _st.median([_timed(lambda: resume.analyze_run(
+            d, resume=True)) for _ in range(3)])
+    _log(f"analyze-resume: fresh {fresh:.2f}s resumed {resumed:.2f}s "
+         f"({n_ops} ops)")
+    return {
+        "metric": f"analyze --resume wall time ({n_ops}-op stored run)",
+        "value": round(resumed, 3),
+        "unit": "s",
+        "vs_baseline": round(fresh / max(resumed, 1e-9), 2),
+    }
+
+
+def _timed(f) -> float:
+    t0 = time.time()
+    f()
+    return time.time() - t0
+
+
 def _telemetry_lines():
     """Kernel-profile lines derived from the run's telemetry: the
     process-global recorder accumulated compile/execute time and batch
@@ -559,6 +663,9 @@ def main():
         for fn, args in ((bench_monitor_overhead, ()),
                          (bench_trace_overhead, ()),
                          (bench_watchdog_latency, ()),
+                         (bench_fallback_overhead,
+                          (32 if small else 64,)),
+                         (bench_analyze_resume, ()),
                          (bench_list_append,
                           (10_000 if small else 100_000,)),
                          (bench_rw_register,

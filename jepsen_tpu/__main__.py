@@ -115,10 +115,37 @@ def make_test(opts: dict) -> dict:
     if opts.get("trace"):
         # per-op causal tracing (optrace.jsonl + anomaly provenance)
         test["trace?"] = True
+    if opts.get("quarantine"):
+        # per-node circuit breakers: a dead node degrades the run
+        # instead of aborting it (doc/robustness.md)
+        test["quarantine?"] = True
     for k, v in w.items():
         if k not in ("generator", "checker", "final_generator"):
             test[k] = v
+    # crash-safety knobs (doc/robustness.md): the reconstructible spec
+    # lets `analyze <run-dir>` rebuild this exact checker stack after a
+    # control-process crash; persistent wgl segment checkpoints make
+    # that re-analysis resume instead of re-search.
+    test["spec"] = {"workload": name, "opts": _spec_opts(opts)}
+    test.setdefault("checkpoint?", True)
     return test
+
+
+def _spec_opts(opts: dict) -> dict:
+    """The JSON-representable subset of the option map — everything
+    make_test needs to rebuild the same test (store.save_spec writes
+    it as spec.json)."""
+    def plain(v):
+        if isinstance(v, (str, int, float, bool, type(None))):
+            return True
+        if isinstance(v, (list, tuple)):
+            return all(plain(x) for x in v)
+        if isinstance(v, dict):
+            return all(isinstance(k, str) and plain(x)
+                       for k, x in v.items())
+        return False
+
+    return {k: v for k, v in opts.items() if plain(v)}
 
 
 def _generator(opts: dict, w: dict):
@@ -153,6 +180,10 @@ def _workload_opt(p):
     p.add_argument("--trace", action="store_true",
                    help="Record the per-op causal trace "
                         "(optrace.jsonl; see doc/observability.md).")
+    p.add_argument("--quarantine", action="store_true",
+                   help="Quarantine persistently unreachable nodes "
+                        "and continue the run :degraded instead of "
+                        "aborting (doc/robustness.md).")
     return p
 
 
@@ -165,6 +196,7 @@ def main(argv=None) -> None:
     commands.update(cli.serve_cmd())
     commands.update(cli.telemetry_cmd())
     commands.update(cli.trace_cmd())
+    commands.update(cli.analyze_cmd(make_test))
     cli.run_cli(commands, argv)
 
 

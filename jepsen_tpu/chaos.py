@@ -1,0 +1,285 @@
+"""Harness chaos rig: seeded fault injection for the harness ITSELF.
+
+Jepsen injects faults into the system under test; this module injects
+faults into *jepsen's own plumbing* — flaky control transports, client
+calls that time out, duplicate, or blow up — to prove the pipeline
+keeps its crash-safety promises under the same abuse it dishes out.
+The invariants a chaotic run must keep (tests/test_chaos.py asserts
+all of them):
+
+  1. the run TERMINATES — no fault wedges the interpreter;
+  2. the history stays WELL-FORMED (validate_history below);
+  3. teardown HEALS — the final heal fires even when the nemesis died;
+  4. the STORE VALIDATES — history.jlog is fully readable, results
+     land;
+  5. analysis SUCCEEDS OR DEGRADES CLEANLY — valid? is True, False, or
+     'unknown', never an exception.
+
+Faults are driven by util.seeded_rng, so a failing combination replays
+from its seed. Rates are per-call probabilities:
+
+  drop-connection  control: TransportError BEFORE the command runs
+                   client:  definite :fail (the op never executed)
+  command-timeout  control: the command RUNS, then TransportError (the
+                   classic indeterminate window — a retry double-
+                   applies, exactly the hazard retries create)
+                   client:  the op RUNS, then completes :info
+  duplicate        the command/op is applied twice (an internally
+                   retrying client), completion reports the second run
+  exception        client only: the invoke raises — the interpreter
+                   must crash the worker to :info and reincarnate the
+                   process
+
+See doc/robustness.md.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import Counter
+from typing import Optional
+
+from . import client as jclient
+from . import telemetry, util
+from .nemesis import core as _jnemesis_core
+from .control.core import Action, Remote, Result, Session, TransportError
+from .history import History
+
+DEFAULT_REMOTE_RATES = {
+    "drop-connection": 0.05,
+    "command-timeout": 0.05,
+    "duplicate": 0.02,
+}
+
+DEFAULT_CLIENT_RATES = {
+    "drop-connection": 0.05,
+    "command-timeout": 0.05,
+    "duplicate": 0.03,
+    "exception": 0.03,
+}
+
+
+class ChaosError(RuntimeError):
+    """The injected client-side failure (a 'bug' in the client/worker
+    the interpreter must absorb as a crash-to-:info)."""
+
+
+class _Injector:
+    """Shared seeded dice + tally. One per wrapped session/client so
+    call sequences stay deterministic per (seed, scope)."""
+
+    def __init__(self, seed, scope: tuple, rates: dict,
+                 tally: Counter):
+        self.rng = util.seeded_rng(seed, *scope)
+        self.rates = rates
+        self.tally = tally
+
+    def roll(self) -> Optional[str]:
+        """At most one fault per call: the rates partition [0, 1)."""
+        r = self.rng.random()
+        acc = 0.0
+        for kind, p in self.rates.items():
+            acc += p
+            if r < acc:
+                self.tally[kind] += 1
+                telemetry.count(f"chaos.{kind}")
+                return kind
+        return None
+
+
+class ChaosSession(Session):
+    def __init__(self, inner: Session, inj: _Injector, node):
+        self.inner = inner
+        self.inj = inj
+        self.node = node
+
+    def execute(self, action: Action) -> Result:
+        kind = self.inj.roll()
+        if kind == "drop-connection":
+            raise TransportError("chaos: connection dropped",
+                                 node=self.node, cmd=action.cmd)
+        res = self.inner.execute(action)
+        if kind == "duplicate":
+            res = self.inner.execute(action)
+        elif kind == "command-timeout":
+            # the command RAN; the caller only sees a dead transport
+            raise TransportError("chaos: command timed out after "
+                                 "completing", node=self.node,
+                                 cmd=action.cmd)
+        return res
+
+    def upload(self, local_paths, remote_path) -> None:
+        if self.inj.roll() == "drop-connection":
+            raise TransportError("chaos: connection dropped",
+                                 node=self.node)
+        return self.inner.upload(local_paths, remote_path)
+
+    def download(self, remote_paths, local_path) -> None:
+        if self.inj.roll() == "drop-connection":
+            raise TransportError("chaos: connection dropped",
+                                 node=self.node)
+        return self.inner.download(remote_paths, local_path)
+
+    def disconnect(self) -> None:
+        self.inner.disconnect()
+
+
+class ChaosRemote(Remote):
+    """Wraps a Remote so every session misbehaves with seeded
+    probabilities. `tally` (a Counter) records what was injected."""
+
+    def __init__(self, inner: Remote, seed=0, rates: dict | None = None,
+                 connect_rate: float = 0.0):
+        self.inner = inner
+        self.seed = seed
+        self.rates = dict(DEFAULT_REMOTE_RATES if rates is None
+                          else rates)
+        self.connect_rate = connect_rate
+        self.tally: Counter = Counter()
+        self._lock = threading.Lock()
+        self._n_conns: Counter = Counter()
+
+    def connect(self, conn_spec: dict) -> Session:
+        host = conn_spec.get("host")
+        with self._lock:
+            self._n_conns[host] += 1
+            nth = self._n_conns[host]
+        inj = _Injector(self.seed, ("remote", str(host), nth),
+                        self.rates, self.tally)
+        if self.connect_rate and inj.rng.random() < self.connect_rate:
+            self.tally["connect-refused"] += 1
+            telemetry.count("chaos.connect-refused")
+            raise TransportError("chaos: connect refused", node=host)
+        return ChaosSession(self.inner.connect(conn_spec), inj, host)
+
+
+class ChaosClient(jclient.Client):
+    """Wraps a Client so invocations misbehave with seeded
+    probabilities. Each fault maps to an HONEST completion — a dropped
+    op (never ran) is a definite :fail, a timed-out op (ran!) is
+    :info, an injected exception crashes the worker — so a correct
+    checker over a chaotic history still reaches a sound verdict."""
+
+    def __init__(self, inner: jclient.Client, seed=0,
+                 rates: dict | None = None, tally: Counter | None = None,
+                 _inj: _Injector | None = None,
+                 _shared=None):
+        self.inner = inner
+        self.seed = seed
+        self.rates = dict(DEFAULT_CLIENT_RATES if rates is None
+                          else rates)
+        self.tally = tally if tally is not None else Counter()
+        self._inj = _inj
+        # open-counter shared across the open tree (workers reopen
+        # clients on process reincarnation: each reopen needs a fresh
+        # deterministic stream)
+        self._shared = _shared if _shared is not None else \
+            {"lock": threading.Lock(), "opens": Counter()}
+
+    def open(self, test, node):
+        with self._shared["lock"]:
+            self._shared["opens"][node] += 1
+            nth = self._shared["opens"][node]
+        inj = _Injector(self.seed, ("client", str(node), nth),
+                        self.rates, self.tally)
+        return ChaosClient(self.inner.open(test, node), self.seed,
+                           self.rates, self.tally, _inj=inj,
+                           _shared=self._shared)
+
+    def setup(self, test):
+        self.inner.setup(test)
+        return self
+
+    def invoke(self, test, op):
+        kind = self._inj.roll() if self._inj is not None else None
+        if kind == "exception":
+            raise ChaosError("chaos: injected worker exception")
+        if kind == "drop-connection":
+            # never reached the database: definite fail
+            return op.copy(type="fail", error="chaos: connection "
+                                              "refused")
+        op2 = self.inner.invoke(test, op)
+        if kind == "duplicate":
+            op2 = self.inner.invoke(test, op)
+        elif kind == "command-timeout":
+            # the op took effect but the reply was lost: indeterminate
+            return op.copy(type="info", error="chaos: timeout")
+        return op2
+
+    def teardown(self, test):
+        self.inner.teardown(test)
+
+    def close(self, test):
+        self.inner.close(test)
+
+    def reusable(self, test):
+        return jclient.is_reusable(self.inner, test)
+
+
+class CrashingNemesis(_jnemesis_core.Nemesis):
+    """A nemesis whose teardown dies — the case final heal must still
+    fire (core.final_heal). Wraps any nemesis; setup/invoke delegate."""
+
+    def __init__(self, inner, crash_teardown: bool = True):
+        self.inner = inner
+        self.crash_teardown = crash_teardown
+
+    def setup(self, test):
+        return CrashingNemesis(self.inner.setup(test),
+                               self.crash_teardown)
+
+    def invoke(self, test, op):
+        return self.inner.invoke(test, op)
+
+    def teardown(self, test):
+        if self.crash_teardown:
+            telemetry.count("chaos.nemesis-teardown-crashes")
+            raise ChaosError("chaos: nemesis teardown crashed")
+        self.inner.teardown(test)
+
+    def fs(self):
+        return self.inner.fs()
+
+
+# ---------------------------------------------------------------------------
+# Invariant checks
+# ---------------------------------------------------------------------------
+
+_COMPLETIONS = ("ok", "fail", "info")
+
+
+def validate_history(hist) -> list[str]:
+    """Well-formedness problems in a history ([] = well-formed):
+    contiguous indices, each client completion pairs the process's open
+    invocation with the same :f, completion types legal, no client op
+    on a process with an already-open invocation."""
+    problems: list[str] = []
+    if not isinstance(hist, History):
+        hist = History(hist)
+    open_inv: dict = {}
+    for i, op in enumerate(hist):
+        if op.index != i:
+            problems.append(
+                f"op {i} has index {op.index} (not contiguous)")
+        if not isinstance(op.process, int):
+            continue  # nemesis ops pair loosely (info/info)
+        if op.type == "invoke":
+            if op.process in open_inv:
+                problems.append(
+                    f"op {op.index}: process {op.process} invoked "
+                    "while already in flight")
+            open_inv[op.process] = op
+        elif op.type in _COMPLETIONS:
+            inv = open_inv.pop(op.process, None)
+            if inv is None:
+                problems.append(
+                    f"op {op.index}: completion without invocation "
+                    f"(process {op.process})")
+            elif inv.f != op.f:
+                problems.append(
+                    f"op {op.index}: completion f={op.f!r} != "
+                    f"invocation f={inv.f!r}")
+        else:
+            problems.append(
+                f"op {op.index}: illegal type {op.type!r}")
+    return problems

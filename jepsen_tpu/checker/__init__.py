@@ -46,14 +46,44 @@ def check(checker: Checker, test, hist, opts=None) -> dict:
     return checker.check(test, _as_history(hist), opts or {})
 
 
-def check_safe(checker: Checker, test, hist, opts=None) -> dict:
+_TIMED_OUT = object()
+
+
+def checker_timeout_s(test, opts=None) -> float | None:
+    """The per-checker wall-clock bound, from opts or the test map
+    (test["checker_timeout_s"]); None = unbounded."""
+    for src in (opts or {}, test if isinstance(test, dict) else {}):
+        v = src.get("checker_timeout_s")
+        if v:
+            return float(v)
+    return None
+
+
+def check_safe(checker: Checker, test, hist, opts=None,
+               timeout_s: float | None = None) -> dict:
     """check, but exceptions degrade to valid? 'unknown'
-    (checker.clj:79-90)."""
-    try:
-        return check(checker, test, hist, opts)
-    except Exception:  # noqa: BLE001
-        logger.exception("Error while checking history:")
-        return {"valid?": "unknown", "error": traceback.format_exc()}
+    (checker.clj:79-90). With timeout_s, a hung checker degrades the
+    same way after that many wall-clock seconds — the worker thread is
+    abandoned, not interrupted (util.timeout), so analysis proceeds to
+    the remaining checkers instead of stalling the whole run."""
+    def body():
+        try:
+            return check(checker, test, hist, opts)
+        except Exception:  # noqa: BLE001
+            logger.exception("Error while checking history:")
+            return {"valid?": "unknown", "error": traceback.format_exc()}
+
+    if not timeout_s:
+        return body()
+    res = util.timeout(timeout_s, body, default=_TIMED_OUT)
+    if res is _TIMED_OUT:
+        telemetry.count("checker.timeouts")
+        logger.warning("checker %s timed out after %.1fs; degrading to "
+                       "valid? unknown", type(checker).__name__,
+                       timeout_s)
+        return {"valid?": "unknown",
+                "error": f"checker timed out after {timeout_s}s"}
+    return res
 
 
 def op_indices(hist: History | None, *ops) -> list[int]:
@@ -126,18 +156,29 @@ class Compose(Checker):
     def check(self, test, hist, opts=None):
         opts = opts or {}
         partial = opts.get("partial_results")  # crash-surviving sink
+        # per-checker wall-clock bound: one hung checker degrades to
+        # valid? 'unknown' instead of stalling the whole analysis
+        timeout_s = checker_timeout_s(test, opts)
+        # results recovered from a crashed analysis's partial log
+        # (analyze --resume): completed checkers are not re-run
+        resumed = opts.get("resume_results") or {}
         # sub-checkers must NOT inherit the sink: a nested compose
         # would write its inner results flat with colliding keys (two
         # 'stats' entries, workload results hoisted to top level)
         sub_opts = {k: v for k, v in opts.items()
-                    if k != "partial_results"}
+                    if k not in ("partial_results", "resume_results")}
 
         def one(kv):
             name, c = kv
-            # per-checker timing: the checker:<name> spans feed the
-            # :telemetry summary core.analyze attaches to results
-            with telemetry.span(f"checker:{name}"):
-                r = check_safe(c, test, hist, sub_opts)
+            if name in resumed:
+                telemetry.count("checker.resumed")
+                r = resumed[name]
+            else:
+                # per-checker timing: the checker:<name> spans feed the
+                # :telemetry summary core.analyze attaches to results
+                with telemetry.span(f"checker:{name}"):
+                    r = check_safe(c, test, hist, sub_opts,
+                                   timeout_s=timeout_s)
             if partial is not None:
                 try:
                     partial.put(name, r)

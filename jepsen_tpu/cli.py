@@ -346,6 +346,47 @@ def trace_cmd() -> dict:
     return {"trace": {"parser_fn": build, "run": run}}
 
 
+def analyze_cmd(test_fn=None) -> dict:
+    """An 'analyze' subcommand: recovers a stored run's history (valid
+    CRC prefix; torn tail dropped) and (re)runs its checkers, writing
+    results.json — the recovery path after a control-process crash.
+    --resume reuses completed checkers from the partial-results log and
+    the wgl segment checkpoints (doc/robustness.md). test_fn rebuilds
+    the checker stack from the run's spec.json (suites pass their own
+    builder; the default is the bundled-workload builder)."""
+    def build(p):
+        _store_run_opts(p)
+        p.add_argument("--resume", action="store_true",
+                       help="Reuse completed checker results and wgl "
+                            "segment checkpoints from the crashed "
+                            "analysis instead of starting over.")
+        p.add_argument("--checker-timeout", type=float, default=None,
+                       metavar="SECS",
+                       help="Per-checker wall-clock bound; a hung "
+                            "checker degrades to valid? unknown.")
+        return p
+
+    def run(options):
+        from . import resume as jresume
+
+        d = _resolve_stored_run(options)
+        if d is None:
+            print(f"no such stored test: {options.test}")
+            return 254
+        test = jresume.analyze_run(
+            d, resume=options.resume, test_fn=test_fn,
+            checker_timeout_s=options.checker_timeout)
+        valid = (test.get("results") or {}).get("valid?")
+        print(f"results written to {d / 'results.json'}")
+        if valid is True:
+            return 0
+        if valid is False:
+            return 1
+        return 2
+
+    return {"analyze": {"parser_fn": build, "run": run}}
+
+
 def serve_cmd() -> dict:
     """A 'serve' subcommand for the web UI (cli.clj:336-354)."""
     def build(p):

@@ -55,6 +55,13 @@ def remote_for(test: dict) -> Remote:
     r = test.get("remote")
     if r is None:
         r = dummy if (test.get("ssh") or {}).get("dummy") else _default_ssh()
+    hr = test.get("health")
+    if hr is not None:
+        # per-node circuit breakers (control/health.py): commands to a
+        # quarantined node fail fast instead of burning retry budgets,
+        # and the run continues :degraded instead of aborting
+        from .health import GuardedRemote
+        r = GuardedRemote(r, hr)
     return r
 
 
@@ -199,11 +206,31 @@ def on_nodes(test: dict, f: Callable[[dict, Any], Any],
 
 def open_sessions(test: dict) -> dict:
     """Opens one session per node in parallel; returns test with
-    :sessions {node: session} (core.clj with-sessions, 266-286)."""
+    :sessions {node: session} (core.clj with-sessions, 266-286).
+    With quarantine enabled (test["health"]), a node that is dead at
+    open time gets a lazy placeholder session instead of aborting the
+    run: its commands retry the connect (feeding the circuit breaker)
+    and fail fast once quarantined (control/health.py)."""
     from .. import util as _util
 
     nodes = list(test.get("nodes") or [])
-    sessions = _util.real_pmap(lambda n: session(test, n), nodes)
+    hr = test.get("health")
+
+    def open_one(n):
+        try:
+            return session(test, n)
+        except RemoteError:
+            if hr is None:
+                raise
+            from .health import LazyConnectSession
+
+            logger.warning(
+                "couldn't open a session to %s; deferring (quarantine "
+                "active — the run continues :degraded)", n)
+            return LazyConnectSession(remote_for(test),
+                                      conn_spec(test, n))
+
+    sessions = _util.real_pmap(open_one, nodes)
     test = dict(test)
     test["sessions"] = dict(zip(nodes, sessions))
     return test

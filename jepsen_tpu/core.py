@@ -14,7 +14,9 @@ analyze! 215-228, snarf-logs! 101-162, synchronize 43-56).
 from __future__ import annotations
 
 import datetime
+import errno
 import logging
+import socket
 import threading
 from pathlib import Path
 from typing import Any
@@ -70,7 +72,57 @@ def _setup_os(test: dict) -> None:
 def _teardown_os(test: dict) -> None:
     os_ = test.get("os")
     if os_ is not None:
-        control.on_nodes(test, lambda t, n: os_.teardown(t, n))
+        _teardown_tolerantly(test, "os",
+                             lambda t, n: os_.teardown(t, n))
+
+
+def _transport_failure(e: BaseException) -> bool:
+    """Couldn't REACH the node: SSH transport errors, refused/reset
+    connections, DNS failures, and the network-errno family of raw
+    OSErrors (EHOSTUNREACH etc., which Python does NOT map onto
+    ConnectionError). Local misconfiguration — FileNotFoundError for a
+    missing binary, TypeError from a client bug — is never transport."""
+    from .control.core import TransportError
+
+    if isinstance(e, (TransportError, ConnectionError, TimeoutError,
+                      socket.gaierror)):
+        return True
+    return (isinstance(e, OSError) and not isinstance(e, socket.herror)
+            and e.errno in (errno.EHOSTUNREACH, errno.ENETUNREACH,
+                            errno.ENETDOWN, errno.EHOSTDOWN,
+                            errno.ETIMEDOUT))
+
+
+def _teardown_tolerantly(test: dict, what: str, node_fn) -> None:
+    """Runs a per-node teardown phase on all nodes; with quarantine
+    active, a dead node's transport failure degrades (logged + counted)
+    instead of aborting the run between history capture and analysis —
+    the history is already safe on disk and is worth analyzing. Every
+    node's teardown is attempted (a bare on_nodes call would surface
+    only the FIRST node's failure, letting a dead node mask a genuine
+    teardown bug on a live one); non-transport failures still raise,
+    carrying all of them."""
+    errs: dict = {}
+
+    def one(t, n):
+        try:
+            node_fn(t, n)
+        except Exception as e:  # noqa: BLE001 — classified below
+            errs[n] = e  # distinct keys per node: no lock needed
+
+    control.on_nodes(test, one)
+    if not errs:
+        return
+    if (test.get("health") is None
+            or not all(_transport_failure(x) for x in errs.values())):
+        failures = [errs[n] for n in sorted(errs, key=str)]
+        if len(failures) == 1:
+            raise failures[0]
+        raise util.RealPmapError(failures)
+    telemetry.count("core.degraded-teardowns")
+    logger.warning("%s teardown failed on unreachable node(s) %s; "
+                   "continuing :degraded", what,
+                   sorted(map(str, errs)))
 
 
 def _db_cycle(test: dict) -> None:
@@ -92,7 +144,8 @@ def _db_cycle(test: dict) -> None:
 def _teardown_db(test: dict) -> None:
     db = test.get("db")
     if db is not None and not test.get("leave_db_running?"):
-        control.on_nodes(test, lambda t, n: db.teardown(t, n))
+        _teardown_tolerantly(test, "db",
+                             lambda t, n: db.teardown(t, n))
 
 
 def snarf_logs(test: dict) -> None:
@@ -124,9 +177,54 @@ def snarf_logs(test: dict) -> None:
         logger.exception("Error snarfing logs")
 
 
+# Bound on the daemon nemesis-teardown join: a nemesis hung in
+# teardown must not stall the run forever, but a silently leaked
+# partition is worse — the timeout is surfaced via telemetry + log,
+# and the final heal below still runs.
+NEMESIS_TEARDOWN_TIMEOUT_S = 60.0
+
+
+def final_heal(test: dict) -> None:
+    """Last-resort cleanup after a case: heal the network and (when the
+    test opted in via restore_clocks?) reset node clocks — even if the
+    nemesis or its teardown thread died. The reference brackets its
+    whole run in teardown forms (core.clj:322-387); without this, a
+    partition opened by a crashed nemesis outlives the test and poisons
+    the next one. Best-effort: failures are logged, never raised."""
+    if not test.get("sessions"):
+        return
+    # a quarantined node can't be healed and must not abort healing
+    # the nodes that ARE reachable
+    hr = test.get("health")
+    if hr is not None and hr.quarantined():
+        test = dict(test)
+        dead = set(hr.quarantined())
+        test["nodes"] = [n for n in (test.get("nodes") or [])
+                         if n not in dead]
+    net = test.get("net")
+    if net is not None:
+        try:
+            with telemetry.span("final-heal"):
+                net.heal(test)
+        except Exception:  # noqa: BLE001 — heal must not sink teardown
+            telemetry.count("core.final-heal-failures")
+            logger.exception("final net heal failed")
+    if test.get("restore_clocks?"):
+        from .nemesis import time as ntime
+
+        try:
+            with telemetry.span("final-clock-restore"):
+                control.on_nodes(test, lambda t, n: ntime._meh_reset())
+        except Exception:  # noqa: BLE001
+            telemetry.count("core.final-heal-failures")
+            logger.exception("final clock restore failed")
+
+
 def run_case(test: dict) -> dict:
     """Sets up clients + nemesis, runs the generator via the interpreter,
-    tears them down (core.clj:175-213)."""
+    tears them down (core.clj:175-213). A final heal (net + clocks) is
+    registered around the whole case so it fires even when the nemesis
+    thread died mid-fault."""
     client = test["client"]
     nem = jnemesis.validate(test.get("nemesis") or jnemesis.noop)
 
@@ -142,11 +240,37 @@ def run_case(test: dict) -> dict:
     nem_thread.start()
 
     def open_one(node):
-        c = jclient.validate(client).open(test, node)
-        c.setup(test)
-        return c
+        c = None
+        try:
+            c = jclient.validate(client).open(test, node)
+            c.setup(test)
+            return c
+        except Exception as e:  # noqa: BLE001 — classified below
+            # couldn't REACH the node (_transport_failure: ssh
+            # transport, connect/timeout, DNS, network errnos) —
+            # degradable under quarantine; a client bug or local
+            # misconfiguration (TypeError, FileNotFoundError for a
+            # missing client binary) still raises and fails the run
+            if test.get("health") is None or not _transport_failure(e):
+                raise
+            if c is not None:
+                # open() succeeded, setup() died: close the half-open
+                # client instead of leaking its connection for the
+                # rest of the (continuing) run
+                try:
+                    c.close(test)
+                except Exception:  # noqa: BLE001 — best-effort
+                    pass
+            # the node's worker will retry opens per-op (ClientWorker
+            # fails ops "no-client" until then); the run continues
+            telemetry.count("core.degraded-client-opens")
+            logger.warning("client open/setup failed on %s; continuing "
+                           ":degraded (quarantine active)", node)
+            return None
 
-    clients = util.real_pmap(open_one, test.get("nodes") or [])
+    clients = [c for c in util.real_pmap(open_one,
+                                         test.get("nodes") or [])
+               if c is not None]
     nem_thread.join()
     if "error" in nem_box:
         raise nem_box["error"]
@@ -157,7 +281,12 @@ def run_case(test: dict) -> dict:
         return interpreter.run(test)
     finally:
         def teardown_nem():
-            nemesis_up.teardown(test)
+            try:
+                nemesis_up.teardown(test)
+            except Exception:  # noqa: BLE001 — teardown is best-effort;
+                # the final heal below still clears partitions
+                telemetry.count("core.nemesis-teardown-failures")
+                logger.exception("nemesis teardown failed")
 
         nt = threading.Thread(target=teardown_nem, daemon=True)
         nt.start()
@@ -168,15 +297,31 @@ def run_case(test: dict) -> dict:
             finally:
                 c.close(test)
 
-        util.real_pmap(close_one, clients)
-        nt.join()
+        try:
+            util.real_pmap(close_one, clients)
+        finally:
+            nt.join(NEMESIS_TEARDOWN_TIMEOUT_S)
+            if nt.is_alive():
+                # the daemon thread is abandoned; whatever faults it
+                # failed to undo are surfaced (and the final heal
+                # below still clears partitions)
+                telemetry.count("core.nemesis-teardown-timeouts")
+                logger.warning(
+                    "nemesis teardown still running after %.0fs; "
+                    "abandoning it (possible leaked faults — final "
+                    "heal will clear network partitions)",
+                    NEMESIS_TEARDOWN_TIMEOUT_S)
+            final_heal(test)
 
 
-def analyze(test: dict, store_ctx=None) -> dict:
+def analyze(test: dict, store_ctx=None, extra_opts: dict | None = None
+            ) -> dict:
     """Runs the checker over the history (core.clj:215-228). With a
     store, composed checkers stream each sub-result to a partial-
     results log as they finish, so a crash mid-analysis leaves the
-    completed results readable (store/format.clj PartialMap)."""
+    completed results readable (store/format.clj PartialMap).
+    extra_opts merge into the checker opts (the resume path passes
+    recovered partial results through here)."""
     from . import checker as jchecker
 
     logger.info("Analyzing...")
@@ -184,7 +329,7 @@ def analyze(test: dict, store_ctx=None) -> dict:
     if checker is None:
         checker = jchecker.unbridled_optimism()
     test = dict(test)
-    opts = {}
+    opts = dict(extra_opts or {})
     partial = None
     if store_ctx is not None:
         try:
@@ -198,11 +343,18 @@ def analyze(test: dict, store_ctx=None) -> dict:
     if trace_dir is None and store_ctx is not None and test.get(
             "profile?"):
         trace_dir = store_ctx.path(test, "xprof")
+    # a hung non-composed checker gets the same wall-clock bound the
+    # Compose applies per sub-checker; composed checkers are bounded
+    # individually inside (one outer bound would cap the whole set)
+    timeout_s = None
+    if not isinstance(checker, jchecker.Compose):
+        timeout_s = jchecker.checker_timeout_s(test, opts)
     try:
         with telemetry.span("analyze"):
             with util.profile_trace(trace_dir):
                 test["results"] = jchecker.check_safe(
-                    checker, test, test["history"], opts)
+                    checker, test, test["history"], opts,
+                    timeout_s=timeout_s)
     finally:
         if partial is not None:
             partial.close()
@@ -217,6 +369,16 @@ def analyze(test: dict, store_ctx=None) -> dict:
             test["results"]["watchdog"] = wd.results()
             if test.get("aborted"):
                 test["results"]["watchdog"]["aborted"] = test["aborted"]
+        # quarantined-node runs finish with a :degraded marker instead
+        # of aborting: the verdict stands, but readers see which nodes
+        # the control plane gave up on (control/health.py)
+        hr = test.get("health")
+        if hr is not None and hr.ever_quarantined():
+            test["results"]["degraded"] = {
+                "quarantined-nodes": sorted(
+                    map(str, hr.ever_quarantined())),
+                "still-quarantined": sorted(
+                    map(str, hr.quarantined()))}
     logger.info("Analysis complete")
     return test
 
@@ -279,6 +441,14 @@ def run(test: dict) -> dict:
                       if test.get("store_dir") else None)
             try:
                 with telemetry.span("run", test=test.get("name")):
+                    if test.get("quarantine?"):
+                        # per-node circuit breakers: a persistently
+                        # dead node is quarantined (its ops crash fast
+                        # to :info) and the run continues :degraded
+                        # instead of aborting (control/health.py)
+                        from .control import health as jhealth
+                        test["health"] = jhealth.HealthRegistry.from_test(
+                            test)
                     test = control.open_sessions(test)
                     try:
                         with telemetry.span("os-setup"):

@@ -1,0 +1,195 @@
+"""Resumable analysis: recover a crashed run's history and re-check it.
+
+A control-process crash (OOM-killed, SIGKILL, power loss) mid-run used
+to forfeit the whole test. The store already journals everything needed
+to finish the job — the CRC-framed op log survives with at most a torn
+tail (store/format.py), wgl segment checkpoints persist per-(segment,
+state) search results, and the partial-results log holds every checker
+that completed before the crash. This module stitches those together:
+
+  python -m jepsen_tpu analyze <run-dir> [--resume]
+
+  1. recovers the valid history prefix from history.jlog (torn tail
+     dropped — the same recovery rule the writer uses on reopen);
+  2. rebuilds the checker stack from the run's spec.json (a
+     reconstructible test spec serialized at run start — store.save_spec);
+  3. re-runs analysis; with --resume, checkers that already landed in
+     results.partial.jlog are reused verbatim and the wgl segmented
+     search reloads its frontier checkpoints (test["checkpoint?"]);
+  4. writes results.json exactly as an uninterrupted run would.
+
+So a kill -9 mid-run loses seconds of work, not the run. See
+doc/robustness.md.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from pathlib import Path
+
+from . import telemetry, util
+
+logger = logging.getLogger(__name__)
+
+# live/lifecycle objects a rebuilt spec test may carry that offline
+# analysis must not touch (no cluster exists anymore)
+_LIFECYCLE_KEYS = ("client", "generator", "final_generator", "nemesis",
+                   "db", "os", "remote", "sessions", "barrier",
+                   "history_writer", "monitor", "watchdog", "net")
+
+
+def recover_history(d):
+    """The valid history prefix from <d>/history.jlog: intact CRC
+    records only, torn/corrupt tail dropped (store.format crash
+    recovery). Returns (History, ops_recovered)."""
+    from .store import format as fmt
+
+    p = Path(d) / "history.jlog"
+    hist = fmt.read_history(p)
+    return hist, len(hist)
+
+
+def _fallback_checker():
+    """When a run predates spec.json: generic checkers that apply to
+    any history. The verdict degrades honestly — stats/exceptions say
+    what happened, nothing claims workload-level validity."""
+    from . import checker as chk
+
+    return chk.compose({"stats": chk.stats(),
+                        "exceptions": chk.unhandled_exceptions()})
+
+
+def rebuild_test(d, test_fn=None) -> dict:
+    """A test map for offline analysis of stored run `d`: the stored
+    scalars (test.json) plus a checker stack rebuilt from spec.json via
+    test_fn (default: the bundled-workload builder in __main__)."""
+    from . import store as jstore
+
+    d = Path(d)
+    with open(d / "test.json") as f:
+        stored = json.load(f)
+    spec = jstore.load_spec(d)
+    test: dict = {}
+    if spec and isinstance(spec.get("opts"), dict):
+        try:
+            if test_fn is None:
+                from .__main__ import make_test as test_fn  # noqa: PLC0415
+            opts = dict(spec["opts"])
+            if spec.get("workload"):
+                opts.setdefault("workload", spec["workload"])
+            test = dict(test_fn(opts))
+            for k in _LIFECYCLE_KEYS:
+                test.pop(k, None)
+        except (Exception, SystemExit) as e:  # noqa: BLE001 —
+            # SystemExit included: CLI test builders sys.exit on an
+            # unknown workload. A spec this analyzer can't rebuild
+            # (suite-only workload, schema drift) must still yield
+            # results, just generic ones.
+            logger.warning(
+                "couldn't rebuild the checker stack from %s's spec.json "
+                "(%s); falling back to generic stats/exception checkers",
+                d, e)
+            test = {"checker": _fallback_checker(),
+                    "rebuilt-from": "fallback"}
+    else:
+        logger.warning(
+            "%s has no spec.json (run predates resumable analysis?); "
+            "falling back to generic stats/exception checkers", d)
+        test["checker"] = _fallback_checker()
+        test["rebuilt-from"] = "fallback"
+    # stored scalars win: name/start_time must address THIS run dir
+    for k, v in stored.items():
+        if k not in ("results", "history") and k not in _LIFECYCLE_KEYS:
+            test[k] = v
+    test["store_dir"] = str(d)
+    return test
+
+
+def analyze_run(d, resume: bool = False, test_fn=None,
+                checker_timeout_s: float | None = None) -> dict:
+    """Recovers `d`'s history and (re)runs analysis over it, writing
+    results.json. With resume=True, completed checkers are reused from
+    the crash-surviving partial-results log and the wgl segmented
+    search reloads its per-segment checkpoints."""
+    from . import core
+    from . import store as jstore
+    from .store import format as fmt
+
+    d = Path(d)
+    test = rebuild_test(d, test_fn=test_fn)
+    hist, n_ops = recover_history(d)
+    test["history"] = hist
+    if checker_timeout_s:
+        test["checker_timeout_s"] = checker_timeout_s
+
+    extra_opts: dict = {}
+    resumed_names: list = []
+    if resume:
+        # reuse the crashed analysis's completed checkers — read the
+        # partial log BEFORE core.analyze truncates it for this pass
+        partial_p = d / "results.partial.jlog"
+        if partial_p.exists():
+            got = fmt.read_partial_results(partial_p)
+            # a checker that degraded to 'unknown' (timeout, hung, or
+            # crashed) is re-run, not reused: resuming with a larger
+            # --checker-timeout must be able to improve on it
+            got = {k: v for k, v in got.items()
+                   if not (isinstance(v, dict)
+                           and v.get("valid?") == "unknown")}
+            if got:
+                extra_opts["resume_results"] = got
+                resumed_names = sorted(got)
+        # the segmented wgl search reloads its frontier checkpoints
+        # (checker-frontier/*.jlog, keyed by history fingerprint)
+        test["checkpoint?"] = True
+
+    # degraded/watchdog sections can't be recomputed offline (no live
+    # health registry or watchdog survives the crash) — carry them over
+    # from the original results.json before this pass overwrites it
+    prev_results: dict = {}
+    try:
+        with open(d / "results.json") as f:
+            prev_results = json.load(f)
+    except (OSError, ValueError):
+        pass  # crashed before analysis, or torn write: nothing to keep
+
+    telemetry.reset()
+    with util.with_relative_time():
+        with telemetry.span("analyze-offline", run=str(d)):
+            test = core.analyze(test, store_ctx=jstore,
+                                extra_opts=extra_opts)
+    if isinstance(test.get("results"), dict):
+        res = test["results"]
+        # a completed checker whose name the rebuilt stack doesn't
+        # carry (fallback path, renamed checker) is still the verdict
+        # --resume exists to preserve: merge it in rather than
+        # silently dropping it while claiming it was reused
+        orphans = {k: v
+                   for k, v in extra_opts.get("resume_results",
+                                              {}).items()
+                   if k not in res}
+        if orphans:
+            from . import checker as chk
+
+            res.update(orphans)
+            res["valid?"] = chk.merge_valid(
+                [res.get("valid?")]
+                + [(v or {}).get("valid?") for v in orphans.values()
+                   if isinstance(v, dict)])
+        if isinstance(prev_results, dict):
+            for k in ("degraded", "watchdog"):
+                if k in prev_results and k not in res:
+                    res[k] = prev_results[k]
+        test["results"]["analysis"] = {
+            "offline?": True,
+            "resumed?": bool(resume),
+            "recovered-ops": n_ops,
+            "resumed-checkers": resumed_names,
+        }
+    # results.json only: save_results would retire the store-wide
+    # `current` symlink (owned by whichever run is live right now) and
+    # clobber the run's original test.json with the rebuilt map
+    jstore.save_results_only(test)
+    core.log_results(test)
+    return test

@@ -8,6 +8,10 @@ jepsen.log (468-512), load (108-134) and delete! GC (514-531).
 Layout:
   store/<name>/<YYYYMMDDTHHMMSS.ffff>/
     test.json      save-0: the test map, minus the history/results
+    spec.json      save-0: reconstructible test spec (test["spec"]) —
+                   `python -m jepsen_tpu analyze <dir>` rebuilds the
+                   checker stack from it after a control-process crash
+                   (doc/robustness.md)
     history.jlog   incremental CRC-framed op log (store.format)
     results.json   save-2: checker results
     jepsen.log     per-test log output
@@ -28,6 +32,7 @@ from __future__ import annotations
 import datetime
 import json
 import logging
+import os
 import shutil
 from pathlib import Path
 from typing import Any, Iterator
@@ -42,7 +47,7 @@ BASE = Path("store")
 _SKIP_KEYS = {"history", "results", "barrier", "db", "client", "nemesis",
               "checker", "generator", "os", "remote", "sessions",
               "history_writer", "store_dir", "_log_handler",
-              "monitor", "watchdog", "monitor_probes"}
+              "monitor", "watchdog", "monitor_probes", "health"}
 
 
 def base_dir(test: dict | None = None) -> Path:
@@ -86,9 +91,34 @@ def save_test_map(test: dict) -> None:
         json.dump(view, f, indent=1, default=repr)
 
 
+def save_spec(test: dict) -> None:
+    """Writes the reconstructible test spec (test["spec"]) as
+    spec.json, so a crashed run's analysis can rebuild its checker
+    stack without the original process (`analyze` subcommand)."""
+    spec = test.get("spec")
+    if not spec:
+        return
+    d = Path(test["store_dir"])
+    with open(d / "spec.json", "w") as f:
+        json.dump(fmt.jsonable(spec), f, indent=1, default=repr)
+
+
+def load_spec(d) -> dict | None:
+    """The reconstructible test spec a run saved at start, or None for
+    runs that predate (or never carried) one."""
+    p = Path(d) / "spec.json"
+    if not p.exists():
+        return None
+    try:
+        with open(p) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
 def start_test(test: dict) -> dict:
     """save-0: creates the store dir, symlinks, log file, initial test
-    map, and attaches an incremental history writer."""
+    map + spec, and attaches an incremental history writer."""
     test = dict(test)
     d = test_dir(test)
     d.mkdir(parents=True, exist_ok=True)
@@ -97,6 +127,14 @@ def start_test(test: dict) -> dict:
     _symlink(base_dir(test) / "latest", d)
     _symlink(base_dir(test) / "current", d)
     save_test_map(test)
+    save_spec(test)
+    # liveness marker: the web UI must not advertise a RUNNING test as
+    # '[recoverable]' just because a long checker phase went quiet —
+    # as long as this pid is alive, the run is live (web.py)
+    try:
+        (d / "run.pid").write_text(str(os.getpid()))
+    except OSError:
+        pass
     test["history_writer"] = fmt.HistoryWriter(d / "history.jlog")
     _start_logging(test)
     return test
@@ -119,12 +157,20 @@ def stop(test: dict) -> None:
         w.close()
 
 
-def save_results(test: dict) -> dict:
-    """save-2: writes checker results."""
+def save_results_only(test: dict) -> None:
+    """results.json alone — offline re-analysis (`analyze` over a
+    stored run) must not retire the store's `current` symlink (it
+    belongs to whichever run is LIVE) or overwrite the run's original
+    test.json with the rebuilt map."""
     d = Path(test["store_dir"])
     with open(d / "results.json", "w") as f:
         json.dump(fmt.jsonable(test.get("results")), f, indent=1,
                   default=repr)
+
+
+def save_results(test: dict) -> dict:
+    """save-2: writes checker results."""
+    save_results_only(test)
     save_test_map(test)
     cur = base_dir(test) / "current"
     if cur.is_symlink():

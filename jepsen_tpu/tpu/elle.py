@@ -492,6 +492,28 @@ def annotate_op_indices(result: dict, hist) -> dict:
 _DEVICE_MIN_OPS = 4000
 
 
+def _degrade_to_host(which: str, e: Exception) -> list[str]:
+    """Device-engine failure (XLA OOM / compile): count the ladder
+    rung and fall back to the host reference engine, which computes
+    the identical anomaly set (the differential tests pin this). A
+    non-device exception is a real bug and re-raises."""
+    import logging
+
+    from .wgl import device_error_kind
+
+    kind = device_error_kind(e)
+    if kind is None:
+        raise e
+    from .. import telemetry
+
+    telemetry.count(f"elle.ladder.{kind}")
+    telemetry.count("elle.ladder.host-fallback")
+    logging.getLogger(__name__).warning(
+        "elle %s device engine failed (%s: %s); falling back to the "
+        "host engine", which, kind, str(e)[:200])
+    return [kind, "host-fallback"]
+
+
 def check_list_append(hist, opts: dict | None = None) -> dict:
     """elle.list-append/check equivalent: infers the dependency graph
     from append/read txns and reports anomalies.
@@ -503,6 +525,7 @@ def check_list_append(hist, opts: dict | None = None) -> dict:
     if not isinstance(hist, History):
         hist = History(hist)
     engine = (opts or {}).get("engine", "auto")
+    degraded = None
     if engine == "device" or (engine == "auto"
                               and len(hist) >= _DEVICE_MIN_OPS):
         from . import elle_device
@@ -512,19 +535,24 @@ def check_list_append(hist, opts: dict | None = None) -> dict:
         except elle_device.Unvectorizable:
             if engine == "device":
                 raise
+        except Exception as e:  # noqa: BLE001 — device ladder
+            degraded = _degrade_to_host("list-append", e)
     a = AppendAnalysis(hist)
     anomalies = dict(a.anomalies)
     for name, ws in cycle_anomalies(len(a.txns), a.edges,
                                     a.txns).items():
         anomalies[name] = ws
     types = sorted(anomalies.keys())
-    return annotate_op_indices({
+    out = {
         "valid?": not anomalies,
         "anomaly-types": types,
         "anomalies": {k: v[:8] for k, v in anomalies.items()},
         "edge-count": len(a.edges),
         "txn-count": len(a.txns),
-    }, hist)
+    }
+    if degraded:
+        out["degradation"] = degraded
+    return annotate_op_indices(out, hist)
 
 
 def check_rw_register(hist, opts: dict | None = None) -> dict:
@@ -545,6 +573,7 @@ def check_rw_register(hist, opts: dict | None = None) -> dict:
     want_device = (engine == "device"
                    or (engine == "auto"
                        and len(hist) >= _DEVICE_MIN_OPS))
+    degraded = None
     if want_device:
         from . import elle_device
 
@@ -553,6 +582,9 @@ def check_rw_register(hist, opts: dict | None = None) -> dict:
                 elle_device.check_rw_register_device(hist), hist)
         except elle_device.Unvectorizable:
             pass  # host edge inference below; SCC still on device
+        except Exception as e:  # noqa: BLE001 — device ladder
+            degraded = _degrade_to_host("rw-register", e)
+            want_device = False  # host SCC too: the device just failed
     txns = collect(hist)
     anomalies: dict[str, list] = defaultdict(list)
     writer: dict = {}
@@ -639,32 +671,39 @@ def check_rw_register(hist, opts: dict | None = None) -> dict:
                 if w is not None and w.i != t.i and w.type == h.OK:
                     edges.append((t.i, w.i, RW))
     committed = [t for t in txns if t.type == h.OK]
+    cyc = None
     if want_device:
         # unvectorizable values (e.g. strings): edge inference stayed
         # host-side above, but cycle detection still rides the batched
         # device SCC over plain int txn-index edges
         from . import elle_device
 
-        e = (np.asarray(edges, dtype=np.int64).reshape(-1, 3)
-             if edges else np.empty((0, 3), dtype=np.int64))
-        o_src, o_dst, o_ty = order_edge_arrays(committed)
-        src = np.concatenate([e[:, 0], o_src])
-        dst = np.concatenate([e[:, 1], o_dst])
-        ty = np.concatenate([e[:, 2], o_ty])
-        n_edges = int(len(src))
-        cyc = elle_device.cycle_anomalies_arrays(
-            len(txns), src, dst, ty, txns)
-    else:
+        try:
+            e = (np.asarray(edges, dtype=np.int64).reshape(-1, 3)
+                 if edges else np.empty((0, 3), dtype=np.int64))
+            o_src, o_dst, o_ty = order_edge_arrays(committed)
+            src = np.concatenate([e[:, 0], o_src])
+            dst = np.concatenate([e[:, 1], o_dst])
+            ty = np.concatenate([e[:, 2], o_ty])
+            n_edges = int(len(src))
+            cyc = elle_device.cycle_anomalies_arrays(
+                len(txns), src, dst, ty, txns)
+        except Exception as de:  # noqa: BLE001 — device ladder
+            degraded = _degrade_to_host("rw-register-scc", de)
+    if cyc is None:
         edges.extend(_order_edges(committed))
         n_edges = len(edges)
         cyc = cycle_anomalies(len(txns), edges, txns)
     for name, ws in cyc.items():
         anomalies[name] = ws
-    return annotate_op_indices({
+    out = {
         "valid?": not anomalies,
         "anomaly-types": sorted(anomalies.keys()),
         "anomalies": {k: v[:8] for k, v in anomalies.items()},
         "edge-count": n_edges,
         "txn-count": len(txns),
-    }, hist)
+    }
+    if degraded:
+        out["degradation"] = degraded
+    return annotate_op_indices(out, hist)
 

@@ -275,6 +275,113 @@ class RangeError(Exception):
     callers fall back to the host search."""
 
 
+# ---------------------------------------------------------------------------
+# Device-failure degradation ladder
+# ---------------------------------------------------------------------------
+#
+# The host searches are exact, so a device that can't run the kernel is
+# a performance problem, never a correctness one: analysis steps DOWN —
+# halve the batch (smaller launches fit where one big one OOMed), then
+# halve the search width, then the host search as the floor — instead
+# of dying. "Faster linearizability checking via P-compositionality"
+# (PAPERS.md) is what makes the intermediate rungs sound: decomposed
+# searches answer the same question. Every rung is counted in telemetry
+# (wgl.ladder.*) and the verdict carries the path taken.
+
+def device_error_kind(e: BaseException) -> str | None:
+    """Classifies an exception from a kernel launch: 'oom' (XLA
+    RESOURCE_EXHAUSTED / allocator failure — retry smaller), 'compile'
+    (compilation failure — this shape is unrunnable, don't re-attempt
+    it per sub-batch), 'device' (any other XLA/jax runtime failure —
+    INTERNAL, device lost; degradable, the host floor is exact), or
+    None (not a device failure: re-raise, it's a bug)."""
+    if isinstance(e, (RangeError, EncodingError)):
+        return None
+    s = str(e)
+    if ("RESOURCE_EXHAUSTED" in s or "Out of memory" in s
+            or "out of memory" in s or "OOM" in s):
+        return "oom"
+    if ("error during compilation" in s or "Compilation failure" in s
+            or "UNIMPLEMENTED" in s or "FAILED_PRECONDITION" in s):
+        return "compile"
+    if type(e).__name__ in ("XlaRuntimeError", "JaxRuntimeError"):
+        # XlaRuntimeError is jax's RUNTIME error type too, not only
+        # compilation: a kernel regression lands here, so this kind is
+        # logged loudly (error, not warning) — verdicts stay correct
+        # via the host floor, but a silently dead device path would
+        # cost 10-100x per analysis
+        return "device"
+    return None
+
+
+_ladder_local = _threading.local()
+
+
+def _ladder_steps() -> list | None:
+    return getattr(_ladder_local, "steps", None)
+
+
+def _ladder_note(step: str) -> None:
+    """Counts a degradation rung and records it on the ambient ladder
+    scope (analysis attaches the path to its verdict)."""
+    telemetry.count(f"wgl.ladder.{step}")
+    steps = _ladder_steps()
+    if steps is not None and (not steps or steps[-1] != step):
+        steps.append(step)
+
+
+class _ladder_scope:
+    """Collects the degradation rungs walked during one analysis call
+    (thread-local; nested scopes share the outermost list)."""
+
+    def __enter__(self):
+        self.own = _ladder_steps() is None
+        if self.own:
+            _ladder_local.steps = []
+        return _ladder_local.steps
+
+    def __exit__(self, *exc):
+        if self.own:
+            _ladder_local.steps = None
+        return False
+
+
+class _ladder_fork:
+    """Records rungs on a fresh list — one result's OWN provenance —
+    then merges them back onto the enclosing scope. Slicing the shared
+    scope list instead would let its consecutive-dedup suppress a rung
+    that belongs to a different result (e.g. chunk B's OOM after chunk
+    A's in analysis_batch_streamed). Telemetry counts happen inside
+    _ladder_note, so the merge only appends, never re-counts."""
+
+    def __enter__(self):
+        self.outer = _ladder_steps()
+        _ladder_local.steps = []
+        return _ladder_local.steps
+
+    def __exit__(self, *exc):
+        forked = _ladder_local.steps
+        _ladder_local.steps = self.outer
+        if self.outer is not None:
+            for s in forked:
+                if not self.outer or self.outer[-1] != s:
+                    self.outer.append(s)
+        return False
+
+
+def _ladder_classify(e: BaseException, what: str) -> str:
+    """One device failure becomes one counted, logged ladder rung; a
+    non-device exception re-raises (a bug, not a rung)."""
+    kind = device_error_kind(e)
+    if kind is None:
+        raise e
+    _ladder_note(kind)
+    log = logger.error if kind == "device" else logger.warning
+    log("%s failed on device (%s: %s); degrading",
+        what, kind, str(e)[:200])
+    return kind
+
+
 class PackedBatch:
     """A bucket of Encoded histories padded to common (M, S).
 
@@ -648,27 +755,78 @@ def _drain(out, reach: bool):
     return res
 
 
+"""Floor of the width-halving rung: below this window the kernel mostly
+answers UNKNOWN anyway, so the ladder goes straight to host."""
+MIN_LADDER_W = 8
+
+
 def check_batch(encs: Sequence[Encoded], W: int = 32,
                 F: int = 64) -> np.ndarray:
     """Checks a batch of encoded histories on device. Returns int8 [B]
     (VALID/INVALID/UNKNOWN). UNKNOWN means the fixed-width search couldn't
-    decide (window or frontier overflow) — fall back to search_host."""
-    pb = PackedBatch(encs)
-    rows = [(i, e.init_state) for i, e in enumerate(encs)]
-    res = _drain(_launch(pb, rows, W, F, reach=False), reach=False)
-    return res[:pb.B]
+    decide (window or frontier overflow) — fall back to search_host.
+
+    Device failures (OOM, compile) walk the degradation ladder instead
+    of raising: halve the batch, then halve the search width, then
+    report UNKNOWN for the affected histories so callers take the host
+    floor. The result is therefore never *wrong* on device failure,
+    only less decisive."""
+    try:
+        pb = PackedBatch(encs)
+        rows = [(i, e.init_state) for i, e in enumerate(encs)]
+        res = _drain(_launch(pb, rows, W, F, reach=False), reach=False)
+        return res[:pb.B]
+    except Exception as e:  # noqa: BLE001 — device ladder
+        kind = _ladder_classify(e, "batched kernel")
+    # a compile failure is deterministic for the shape: re-attempting
+    # compilation on every halved sub-batch would just fail B more
+    # times, so that rung is skipped (width-halving below DOES change
+    # the compiled shape and still applies)
+    if kind != "compile" and len(encs) > 1:
+        # smaller launches fit where one big one OOMed (and isolate a
+        # poisoned shape bucket to half the batch)
+        _ladder_note("batch-halved")
+        mid = len(encs) // 2
+        return np.concatenate([check_batch(encs[:mid], W, F),
+                               check_batch(encs[mid:], W, F)])
+    if W > MIN_LADDER_W:
+        # a narrower window/frontier shrinks every per-step tensor;
+        # histories needing the wider window come back UNKNOWN, which
+        # is sound (host fallback decides)
+        _ladder_note("width-halved")
+        return check_batch(encs, max(W // 2, MIN_LADDER_W),
+                           max(F // 2, 2 * MIN_LADDER_W))
+    _ladder_note("host-floor")
+    return np.full(len(encs), UNKNOWN, dtype=np.int8)
 
 
 def check_batch_reach(encs: Sequence[Encoded], W: int = 32,
                       F: int = 32) -> tuple[np.ndarray, np.ndarray]:
     """Exhaustive reachability over a batch: returns (out_mask uint32 [B]
     — bit s set iff the whole history can linearize ending in state s —
-    and unknown bool [B]). Requires every n_states <= 32."""
-    pb = PackedBatch(encs)
-    assert pb.S <= 32, "reach mode packs states into a uint32"
-    rows = [(i, e.init_state) for i, e in enumerate(encs)]
-    out, unk = _drain(_launch(pb, rows, W, F, reach=True), reach=True)
-    return out[:pb.B], unk[:pb.B]
+    and unknown bool [B]). Requires every n_states <= 32. Device
+    failures degrade like check_batch: smaller launches, then all-
+    unknown (callers host-search unknown rows)."""
+    assert max((e.n_states for e in encs), default=1) <= 32, \
+        "reach mode packs states into a uint32"
+    try:
+        pb = PackedBatch(encs)
+        rows = [(i, e.init_state) for i, e in enumerate(encs)]
+        out, unk = _drain(_launch(pb, rows, W, F, reach=True),
+                          reach=True)
+        return out[:pb.B], unk[:pb.B]
+    except Exception as e:  # noqa: BLE001 — device ladder
+        kind = _ladder_classify(e, "batched reach kernel")
+    if kind != "compile" and len(encs) > 1:  # see check_batch
+        _ladder_note("batch-halved")
+        mid = len(encs) // 2
+        a_out, a_unk = check_batch_reach(encs[:mid], W, F)
+        b_out, b_unk = check_batch_reach(encs[mid:], W, F)
+        return (np.concatenate([a_out, b_out]),
+                np.concatenate([a_unk, b_unk]))
+    _ladder_note("host-floor")
+    return (np.zeros(len(encs), dtype=np.uint32),
+            np.ones(len(encs), dtype=bool))
 
 
 # ---------------------------------------------------------------------------
@@ -919,13 +1077,21 @@ def check_segmented(enc: Encoded, target_len: int | None = None,
         if screen_rows:
             ks = sorted(screen_segs)
             kidx = {k: i for i, k in enumerate(ks)}
-            pre_pb = PackedBatch([screen_segs[k][0] for k in ks])
             launch_rows = [(kidx[k], s) for k, s in screen_rows]
-            p_out, p_unk = _drain(
-                _launch(pre_pb, launch_rows, W, F, reach=True),
-                reach=True)
-            p_out = p_out[:len(launch_rows)]
-            p_unk = p_unk[:len(launch_rows)]
+            try:
+                pre_pb = PackedBatch([screen_segs[k][0] for k in ks])
+                p_out, p_unk = _drain(
+                    _launch(pre_pb, launch_rows, W, F, reach=True),
+                    reach=True)
+                p_out = p_out[:len(launch_rows)]
+                p_unk = p_unk[:len(launch_rows)]
+            except Exception as e:  # noqa: BLE001 — ladder rung
+                # screen launch failed: every screened row resolves on
+                # host (the exact search — sound, just slower)
+                _ladder_classify(e, "segmented prefix screen")
+                _ladder_note("segment-host-screen")
+                p_out = np.zeros(len(launch_rows), dtype=np.uint32)
+                p_unk = np.ones(len(launch_rows), dtype=bool)
             for i, (k, s) in enumerate(screen_rows):
                 pre, exact = screen_segs[k]
                 mask = (search_host_reach(pre.with_init(s))
@@ -941,14 +1107,23 @@ def check_segmented(enc: Encoded, target_len: int | None = None,
                 if resolved.get((k, s)) is None]
     if rows:
         # One packed copy per segment; rows share it via the kernel's
-        # row->segment indirection.
-        pb = PackedBatch(segs)
-        out, unk = _drain(_launch(pb, rows, W, F, reach=True),
-                          reach=True)
-        out = out[:len(rows)]
-        unk = unk[:len(rows)]
-        for i, (k, s) in enumerate(rows):
-            resolved[(k, s)] = None if unk[i] else int(out[i])
+        # row->segment indirection. Device failure marks every row
+        # unresolved: the composition below host-searches ONLY the
+        # states it actually reaches (the lazy floor), and each result
+        # still checkpoints, so a retry resumes instead of re-searching.
+        try:
+            pb = PackedBatch(segs)
+            out, unk = _drain(_launch(pb, rows, W, F, reach=True),
+                              reach=True)
+            out = out[:len(rows)]
+            unk = unk[:len(rows)]
+            for i, (k, s) in enumerate(rows):
+                resolved[(k, s)] = None if unk[i] else int(out[i])
+        except Exception as e:  # noqa: BLE001 — ladder rung
+            _ladder_classify(e, "segmented main launch")
+            _ladder_note("segment-host-floor")
+            for k, s in rows:
+                resolved.setdefault((k, s), None)
     if ckpt is not None:
         ckpt.save(resolved)
     reach = 1 << enc.init_state
@@ -1060,8 +1235,19 @@ def analysis(model, hist, algorithm: str = "tpu", W: int | None = None,
                'wgl'  — host search over encoded tables
                'model' — host search stepping model objects
     Result mirrors knossos analysis maps: {'valid?': bool, 'op': ...,
-    'configs': [...], 'analyzer': ...}.
-    """
+    'configs': [...], 'analyzer': ...}. When the device kernel failed
+    (OOM / compile) and analysis stepped down the degradation ladder,
+    the verdict carries the rungs walked as result['degradation']."""
+    with _ladder_scope() as steps:
+        out = _analysis(model, hist, algorithm, W, F, checkpoint_path,
+                        checkpoint_dir)
+        if steps:
+            out["degradation"] = list(steps)
+        return out
+
+
+def _analysis(model, hist, algorithm, W, F, checkpoint_path,
+              checkpoint_dir) -> dict:
     if not isinstance(hist, History):
         hist = History(hist)
     try:
@@ -1112,6 +1298,8 @@ def analysis(model, hist, algorithm: str = "tpu", W: int | None = None,
         return _witness_op_indices(out)
     out = search_host(enc, witness=True)
     out["analyzer"] = "tpu+host-fallback"
+    if _ladder_steps():
+        _ladder_note("host-fallback")
     return _witness_op_indices(out)
 
 
@@ -1149,37 +1337,61 @@ def analysis_batch_streamed(model, hists: Sequence, chunk: int = 256,
                             W if W is not None else 32,
                             F if F is not None else 64,
                             reach=False),
-                    encs, idx_map)
+                    encs, idx_map, [])
         except RangeError:
-            return None, encs, idx_map
+            return None, encs, idx_map, []
+        except Exception as e:  # noqa: BLE001 — device ladder
+            return (None, encs, idx_map,
+                    [_ladder_classify(e, "streamed launch")])
 
     def drain(entry):
-        dev, encs, idx_map = entry
-        res = (_drain(dev, reach=False)[:len(encs)] if dev is not None
-               else [UNKNOWN] * len(encs))
+        dev, encs, idx_map, rungs = entry
+        if dev is not None:
+            try:
+                res = _drain(dev, reach=False)[:len(encs)]
+            except Exception as e:  # noqa: BLE001 — async dispatch
+                # defers device failure to the blocking drain
+                rungs = rungs + [_ladder_classify(e, "streamed drain")]
+                res = [UNKNOWN] * len(encs)
+        else:
+            res = [UNKNOWN] * len(encs)
         for j, i in enumerate(idx_map):
             r = int(res[j])
+            own = list(rungs)
             if r == VALID:
                 results[i] = {"valid?": True, "analyzer": "tpu"}
             else:
                 # Bounded: long invalid/unknown members are localized
                 # segment-wise instead of re-searched whole on host,
                 # keeping the caller's W/F tuning.
-                out = extract_witness(encs[j], W=W, F=F)
+                with _ladder_fork() as sub:
+                    # rungs the witness extraction itself walked (e.g.
+                    # a segmented-search device failure) belong to
+                    # THIS result too
+                    out = extract_witness(encs[j], W=W, F=F)
+                own += sub
                 out["analyzer"] = ("tpu" if r == INVALID
                                    else "tpu+host-fallback")
                 results[i] = out
+            if own:
+                # only this chunk's own failures, not the cumulative
+                # call-wide list: the pipelining means other chunks'
+                # rungs may already be on the ladder scope
+                own = [s for k, s in enumerate(own)
+                       if k == 0 or own[k - 1] != s]
+                results[i].setdefault("degradation", own)
 
-    pending = None
-    for start in range(0, len(hists), chunk):
-        entry = launch(hists[start:start + chunk], start)
-        # drain the PREVIOUS chunk now: the current one is already
-        # dispatched, so the device keeps working while we decode
+    with _ladder_scope():
+        pending = None
+        for start in range(0, len(hists), chunk):
+            entry = launch(hists[start:start + chunk], start)
+            # drain the PREVIOUS chunk now: the current one is already
+            # dispatched, so the device keeps working while we decode
+            if pending is not None:
+                drain(pending)
+            pending = entry
         if pending is not None:
             drain(pending)
-        pending = entry
-    if pending is not None:
-        drain(pending)
     return results
 
 

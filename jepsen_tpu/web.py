@@ -23,6 +23,7 @@ import html as _html
 import io
 import json
 import logging
+import os
 import threading
 import time
 import zipfile
@@ -43,7 +44,13 @@ SSE_MAX_S = 6 * 3600.0
 
 def fast_tests(base: Path | None = None) -> list:
     """Cheap per-test summaries for the home page (web.clj:51-112):
-    reads only results.json, never the history."""
+    reads only results.json, never the history. `flags` surfaces the
+    run's robustness story: 'degraded' (nodes were quarantined),
+    'resumed' (results come from offline `analyze`), 'recoverable' (no
+    results but an op log survives — `analyze --resume` can finish the
+    job; doc/robustness.md). A run whose log is still being written
+    (quiet for < RECOVERABLE_QUIET_S) is live, not crashed, and is
+    not flagged."""
     out = []
     for td in jstore.tests(base=base):
         res = None
@@ -51,10 +58,63 @@ def fast_tests(base: Path | None = None) -> list:
             res = jstore.load_results(td)
         except (OSError, json.JSONDecodeError):
             pass
+        flags = []
+        if isinstance(res, dict):
+            if res.get("degraded"):
+                flags.append("degraded")
+            if (res.get("analysis") or {}).get("offline?"):
+                flags.append("resumed")
+        elif _looks_recoverable(td):
+            flags.append("recoverable")
         out.append({"name": td.parent.name, "time": td.name,
-                    "dir": td,
+                    "dir": td, "flags": flags,
                     "valid": (res or {}).get("valid?", "incomplete")})
     return out
+
+
+# a resultless run whose store went quiet this long is crashed, not
+# live — only then does the home page advertise `analyze --resume`
+RECOVERABLE_QUIET_S = 60.0
+
+
+def _run_pid_alive(td: Path) -> bool:
+    """True if the run's recorded control process still exists — a
+    live run, however quiet (a single checker can compute for minutes
+    without touching any file). Pid reuse can only make a CRASHED run
+    look live (missed flag), never a live run look crashed."""
+    try:
+        pid = int((td / "run.pid").read_text().strip())
+    except (OSError, ValueError):
+        return False
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True  # exists, different owner
+    except OSError:
+        return False
+    return True
+
+
+def _looks_recoverable(td: Path) -> bool:
+    if not (td / "history.jlog").exists():
+        return False  # nothing to recover
+    if _run_pid_alive(td):
+        return False  # still running, just quiet
+    # For runs without a pid marker (older stores), fall back to
+    # quietness over EVERY artifact a live run keeps writing: the op
+    # log goes quiet when the op phase ends, but analysis still logs
+    # (jepsen.log) and streams partial results — a >60s-analysis run
+    # must not be advertised as crashed
+    last = 0.0
+    for name in ("history.jlog", "jepsen.log", "results.partial.jlog",
+                 "telemetry.jsonl", "timeseries.jsonl"):
+        try:
+            last = max(last, (td / name).stat().st_mtime)
+        except OSError:
+            pass
+    return time.time() - last > RECOVERABLE_QUIET_S
 
 
 def _valid_color(valid) -> str:
@@ -71,7 +131,9 @@ def home_html(base: Path | None = None) -> str:
             f"<td>{_html.escape(t['name'])}</td>"
             f"<td><a href='/files/{_html.escape(rel)}/'>"
             f"{_html.escape(t['time'])}</a></td>"
-            f"<td>{_html.escape(str(t['valid']))}</td>"
+            f"<td>{_html.escape(str(t['valid']))}"
+            + (f" <small>[{_html.escape(', '.join(t['flags']))}]"
+               f"</small>" if t["flags"] else "") + "</td>"
             f"<td><a href='/files/{_html.escape(rel)}/results.json'>"
             f"results</a></td>"
             f"<td><a href='/files/{_html.escape(rel)}/jepsen.log'>log"

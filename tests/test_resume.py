@@ -1,0 +1,269 @@
+"""Resumable analysis: a crashed run is recovered by `analyze`
+(doc/robustness.md). In-process crash simulations run in tier-1; the
+full SIGKILL-a-subprocess e2e is marked slow."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from jepsen_tpu import checker, core, resume, store, testing
+from jepsen_tpu import generator as gen
+from jepsen_tpu.store import format as fmt
+
+SPEC = {"workload": "register",
+        "opts": {"workload": "register", "nodes": ["n1", "n2"],
+                 "concurrency": 2, "ssh": {"dummy": True},
+                 "time_limit": 1, "ops": 40, "rate": 1000}}
+
+
+def full_run(tmp_path, name="resume-full"):
+    state = testing.AtomState()
+    test = testing.noop_test()
+    test.update(
+        name=name, store_base=str(tmp_path), nodes=["n1", "n2"],
+        concurrency=2, db=testing.AtomDB(state),
+        client=testing.AtomClient(state, latency_s=0.0002),
+        checker=checker.compose({"stats": checker.stats()}),
+        spec=SPEC,
+        generator=gen.clients(gen.limit(40, lambda: {"f": "read"})))
+    return core.run(test)
+
+
+class TestOfflineAnalyze:
+    def test_reanalysis_matches_original_verdict(self, tmp_path):
+        t = full_run(tmp_path)
+        d = store.path(t)
+        want = t["results"]["valid?"]
+        t2 = resume.analyze_run(d)
+        assert t2["results"]["valid?"] == want
+        assert t2["results"]["analysis"]["offline?"] is True
+        assert t2["results"]["analysis"]["resumed?"] is False
+
+    def test_crashed_run_recovers_valid_prefix(self, tmp_path):
+        """Simulated kill -9 mid-run: results.json never written, the
+        op log has a torn tail. analyze drops the torn record and
+        produces the uninterrupted verdict."""
+        t = full_run(tmp_path)
+        d = store.path(t)
+        want = t["results"]["valid?"]
+        n_ops = len(t["history"])
+        # erase every post-crash artifact and tear the log tail
+        (d / "results.json").unlink()
+        log = d / "history.jlog"
+        with open(log, "r+b") as f:
+            f.truncate(log.stat().st_size - 5)
+        t2 = resume.analyze_run(d, resume=True)
+        assert t2["results"]["valid?"] == want
+        assert t2["results"]["analysis"]["recovered-ops"] == n_ops - 1
+        assert (d / "results.json").exists()
+
+    def test_resume_reuses_partial_results_verbatim(self, tmp_path):
+        """Checkers that completed before the crash are not re-run:
+        their partial-log entries come back byte-for-byte."""
+        t = full_run(tmp_path)
+        d = store.path(t)
+        (d / "results.json").unlink()
+        w = fmt.PartialResultsWriter(d / "results.partial.jlog")
+        w.put("stats", {"valid?": True, "marker": 42})
+        w.close()
+        t2 = resume.analyze_run(d, resume=True)
+        res = t2["results"]
+        assert res["stats"]["marker"] == 42  # reused, not re-run
+        assert res["analysis"]["resumed-checkers"] == ["stats"]
+
+    def test_resume_reruns_unknown_checkers(self, tmp_path):
+        """A checker that degraded to 'unknown' (timed out, hung,
+        crashed) before the crash is re-run on resume — a larger
+        --checker-timeout must be able to improve the verdict."""
+        t = full_run(tmp_path)
+        d = store.path(t)
+        (d / "results.json").unlink()
+        w = fmt.PartialResultsWriter(d / "results.partial.jlog")
+        w.put("stats", {"valid?": "unknown",
+                        "error": "checker timed out after 60s"})
+        w.close()
+        t2 = resume.analyze_run(d, resume=True)
+        res = t2["results"]
+        assert res["stats"]["valid?"] is True  # re-run, not reused
+        assert res["analysis"]["resumed-checkers"] == []
+
+    def test_resume_preserves_orphaned_checker_results(self, tmp_path):
+        """A completed checker the rebuilt (fallback) stack doesn't
+        carry is merged into the results, verdict and all — it's the
+        very thing --resume exists to preserve."""
+        t = full_run(tmp_path)
+        d = store.path(t)
+        (d / "results.json").unlink()
+        (d / "spec.json").unlink()  # forces the generic fallback stack
+        w = fmt.PartialResultsWriter(d / "results.partial.jlog")
+        w.put("workload", {"valid?": False, "marker": 7})
+        w.close()
+        t2 = resume.analyze_run(d, resume=True)
+        res = t2["results"]
+        assert res["workload"]["marker"] == 7  # kept, not dropped
+        assert res["valid?"] is False  # orphan verdict merged
+        assert "workload" in res["analysis"]["resumed-checkers"]
+
+    def test_no_resume_ignores_partials(self, tmp_path):
+        t = full_run(tmp_path)
+        d = store.path(t)
+        w = fmt.PartialResultsWriter(d / "results.partial.jlog")
+        w.put("stats", {"valid?": True, "marker": 42})
+        w.close()
+        t2 = resume.analyze_run(d, resume=False)
+        assert "marker" not in t2["results"]["stats"]
+
+    def test_run_without_spec_falls_back(self, tmp_path):
+        t = full_run(tmp_path)
+        d = store.path(t)
+        (d / "spec.json").unlink()
+        t2 = resume.analyze_run(d)
+        assert t2["results"]["valid?"] in (True, False, "unknown")
+        assert t2["results"]["stats"]["valid?"] is True
+
+    def test_unbuildable_spec_falls_back(self, tmp_path):
+        """make_test sys.exits on an unknown workload; analyzing a run
+        whose spec names one (suite-only workload, schema drift) must
+        degrade to the generic checkers, not kill the CLI."""
+        t = full_run(tmp_path)
+        d = store.path(t)
+        spec = json.loads((d / "spec.json").read_text())
+        spec["workload"] = "no-such-workload"
+        spec["opts"]["workload"] = "no-such-workload"
+        (d / "spec.json").write_text(json.dumps(spec))
+        t2 = resume.analyze_run(d)
+        assert t2["rebuilt-from"] == "fallback"
+        assert t2["results"]["stats"]["valid?"] is True
+
+    def test_offline_analysis_preserves_degraded_marker(self, tmp_path):
+        """A :degraded run re-analyzed offline keeps its quarantine
+        record — no live health registry exists to recompute it."""
+        t = full_run(tmp_path)
+        d = store.path(t)
+        prev = json.loads((d / "results.json").read_text())
+        prev["degraded"] = {"quarantined-nodes": ["n2"],
+                            "still-quarantined": []}
+        (d / "results.json").write_text(json.dumps(prev))
+        t2 = resume.analyze_run(d, resume=True)
+        assert (t2["results"]["degraded"]["quarantined-nodes"]
+                == ["n2"])
+        on_disk = json.loads((d / "results.json").read_text())
+        assert on_disk["degraded"]["quarantined-nodes"] == ["n2"]
+
+    def test_offline_analyze_leaves_live_run_artifacts_alone(
+            self, tmp_path):
+        """analyze over an OLD run must not retire the store-wide
+        `current` symlink (it belongs to whichever run is live) or
+        rewrite the analyzed run's original test.json."""
+        t = full_run(tmp_path)
+        d = store.path(t)
+        before = (d / "test.json").read_text()
+        base = d.parent.parent
+        live = base / "live-run"
+        live.mkdir()
+        cur = base / "current"
+        if cur.is_symlink() or cur.exists():
+            cur.unlink()
+        cur.symlink_to(live.resolve())
+        resume.analyze_run(d, resume=True)
+        assert cur.is_symlink()
+        assert cur.resolve() == live.resolve()
+        assert (d / "test.json").read_text() == before
+
+    def test_analyze_cli_exit_codes(self, tmp_path, monkeypatch):
+        from jepsen_tpu import cli
+
+        t = full_run(tmp_path)
+        d = store.path(t)
+
+        def rebuild(opts):
+            return {"checker": checker.compose(
+                {"stats": checker.stats()}), "name": "x"}
+
+        cmds = cli.analyze_cmd(rebuild)
+        with pytest.raises(SystemExit) as e:
+            cli.run_cli(cmds, ["analyze", str(d), "--resume"])
+        assert e.value.code == 0
+
+    def test_analyze_cli_missing_dir(self, tmp_path):
+        from jepsen_tpu import cli
+
+        with pytest.raises(SystemExit) as e:
+            cli.run_cli(cli.analyze_cmd(None),
+                        ["analyze", str(tmp_path / "nope")])
+        assert e.value.code == 254
+
+
+@pytest.mark.slow
+class TestSigkillE2E:
+    """The acceptance e2e: a run SIGKILLed mid-execution is recovered
+    by `analyze --resume` with the same verdict as an uninterrupted
+    run."""
+
+    REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+    def _env(self):
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        env["PYTHONPATH"] = self.REPO + (
+            os.pathsep + env["PYTHONPATH"]
+            if env.get("PYTHONPATH") else "")
+        return env
+
+    def _run_cli(self, cwd, args, **kw):
+        return subprocess.run(
+            [sys.executable, "-m", "jepsen_tpu", *args],
+            cwd=str(cwd), env=self._env(), capture_output=True,
+            text=True, **kw)
+
+    def test_sigkill_then_analyze_resume(self, tmp_path):
+        env = self._env()
+        args = ["test", "--workload", "register", "--no-ssh",
+                "--nodes", "n1,n2", "--concurrency", "2",
+                "--time-limit", "30", "--rate", "50"]
+        # uninterrupted control run (short)
+        ctl = self._run_cli(
+            tmp_path, [*args[:-4], "--time-limit", "3", "--rate", "50"])
+        assert ctl.returncode == 0, ctl.stderr[-2000:]
+        ctl_results = json.loads(
+            (tmp_path / "store" / "latest" / "results.json")
+            .resolve().read_text())
+        want = ctl_results["valid?"]
+
+        # the victim: SIGKILL mid-execution
+        runs_dir = tmp_path / "store" / "register-demo"
+        before = {p.name for p in runs_dir.glob("2*")}
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "jepsen_tpu", *args],
+            cwd=str(tmp_path), env=env,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+        victim = None
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            dirs = [p for p in runs_dir.glob("2*")
+                    if p.name not in before
+                    and (p / "history.jlog").exists()
+                    and (p / "history.jlog").stat().st_size > 4096]
+            if dirs:
+                victim = sorted(dirs)[-1]
+                break
+            if proc.poll() is not None:
+                break
+            time.sleep(0.25)
+        assert victim is not None, "victim run never produced history"
+        proc.send_signal(signal.SIGKILL)
+        proc.wait()
+        assert not (victim / "results.json").exists()
+
+        out = self._run_cli(tmp_path,
+                            ["analyze", str(victim), "--resume"])
+        assert out.returncode == 0, (out.stdout[-2000:],
+                                     out.stderr[-2000:])
+        got = json.loads((victim / "results.json").read_text())
+        assert got["valid?"] == want
+        assert got["analysis"]["resumed?"] is True
+        assert got["analysis"]["recovered-ops"] > 0

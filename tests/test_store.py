@@ -319,6 +319,79 @@ class TestStoreReviewRegressions:
         assert got["workload"]["bank-ish"]["valid?"] is True
 
 
+class TestCrashRecovery:
+    """ISSUE-5 satellite: the exact crash-window behaviors the resume
+    path depends on."""
+
+    def _write(self, p, n=10):
+        w = fmt.HistoryWriter(p)
+        for i in range(n):
+            w.append(op(index=i, type="ok", process=0, f="read",
+                        value=i))
+        w.close()
+        return p
+
+    def test_valid_prefix_end_drops_torn_final_record(self, tmp_path):
+        p = self._write(tmp_path / "history.jlog")
+        full = p.stat().st_size
+        assert fmt._valid_prefix_end(p) == full
+        with open(p, "r+b") as f:  # crash mid-append of record 10
+            f.truncate(full - 3)
+        end = fmt._valid_prefix_end(p)
+        assert end < full - 3
+        # the prefix end is exactly the 9-record boundary: re-reading
+        # from it yields nothing (no half record counted)
+        with open(p, "r+b") as f:
+            f.truncate(end)
+        assert len(list(fmt.read_ops(p))) == 9
+        assert fmt._valid_prefix_end(p) == end
+
+    def test_lazy_history_truncated_log_yields_sealed_prefix(
+            self, tmp_path):
+        p = tmp_path / "history.jlog"
+        w = fmt.HistoryWriter(p, chunk_size=8)
+        for i in range(40):
+            w.append(op(index=i, type="ok", process=0, f="read",
+                        value=i))
+        w.close()
+        # crash tears the tail back into the 4th chunk
+        with open(p, "r+b") as f:
+            f.truncate(fmt._read_index(p)[3][1] + 7)
+        lazy = fmt.read_history_lazy(p)
+        assert len(lazy) == 32  # 4 sealed chunks survive
+        assert [o.value for o in lazy] == list(range(32))
+
+    def test_read_history_roundtrips_after_mid_append_crash(
+            self, tmp_path):
+        """A writer that dies mid-append leaves a partial frame; the
+        recovered history is the full pre-crash prefix, and a reopened
+        writer continues from exactly there."""
+        import struct
+
+        p = self._write(tmp_path / "history.jlog", n=12)
+        with open(p, "ab") as f:  # half-written frame: header only
+            f.write(struct.pack("<II", 999, 12345))
+            f.write(b"{\"par")
+        hist = fmt.read_history(p)
+        assert len(hist) == 12
+        assert [o.value for o in hist] == list(range(12))
+        w2 = fmt.HistoryWriter(p)
+        w2.append(op(index=12, type="ok", process=0, f="read",
+                     value=12))
+        assert [o.value for o in w2.read_back()] == list(range(13))
+
+    def test_spec_roundtrip(self, tmp_path):
+        test = {"name": "spec-rt", "store_base": str(tmp_path),
+                "store_dir": str(tmp_path / "r"),
+                "spec": {"workload": "register",
+                         "opts": {"nodes": ["n1"], "ops": 10}}}
+        (tmp_path / "r").mkdir()
+        store.save_spec(test)
+        got = store.load_spec(tmp_path / "r")
+        assert got == test["spec"]
+        assert store.load_spec(tmp_path) is None  # absent = None
+
+
 class TestRepl:
     """jepsen_tpu.repl helpers (mirror jepsen/src/jepsen/repl.clj)."""
 
