@@ -8,8 +8,11 @@ Headline (printed LAST, the line the driver records):
   check, median of 5 runs so one noisy pair can't flip the artifact
   (round-2 verdict: the single-shot bench recorded a below-baseline
   outlier); per-rep times + spread ride in the JSON, and a >20% median
-  drop vs the previous BENCH_r*.json round fails loudly (REGRESSION
-  banner + regression fields).
+  drop vs the BEST of the last 3 rounds (perf ledger + BENCH_r*.json)
+  fails loudly (REGRESSION banner + regression fields). Every full-size
+  round appends a per-kernel entry to bench_ledger.jsonl; an EWMA
+  slow-bleed detector (jepsen_tpu.ledger) flags gradual drifts the
+  per-round gate can't see, attributed per kernel (wgl/elle/encode).
 
 Also printed (one JSON line each, config 2 last):
   config 3 — elle list-append dependency-cycle check, 100k txns
@@ -247,18 +250,25 @@ def bench_headline(n_events):
     wgl.check_segmented(enc, target_len=8192)
     _log(f"config2: first check (incl. compile) {time.time() - t0:.2f}s")
 
-    times = []
+    times, enc_times, chk_times = [], [], []
     for _ in range(5):
         t1 = time.time()
         enc = encode(models.cas_register(), hist)
+        t_enc = time.time() - t1
         res = wgl.check_segmented(enc, target_len=8192)
         if res is None:
             res = {"valid?": bool(wgl.check_batch([enc])[0] == wgl.VALID)}
-        times.append(time.time() - t1)
+        t_all = time.time() - t1
+        times.append(t_all)
+        enc_times.append(t_enc)
+        chk_times.append(t_all - t_enc)
         assert res["valid?"] is True, res
     elapsed = statistics.median(times)
     _log(f"config2: encode+check runs {['%.2f' % t for t in times]} "
-         f"median {elapsed:.2f}s segments={res.get('segments')} m={enc.m}")
+         f"median {elapsed:.2f}s (encode "
+         f"{statistics.median(enc_times):.2f}s + check "
+         f"{statistics.median(chk_times):.2f}s) "
+         f"segments={res.get('segments')} m={enc.m}")
     line = {
         "metric": "linearizability check throughput "
                   f"({n_events // 1000}k-event CAS register history)",
@@ -267,19 +277,28 @@ def bench_headline(n_events):
         "vs_baseline": round(target_s / elapsed, 2),
         "runs_s": [round(t, 3) for t in times],
         "spread": round((max(times) - min(times)) / elapsed, 3),
+        # per-kernel attribution for the ledger: a headline drop is a
+        # regression in encode (host) or in the device check — name it
+        "encode_s": round(statistics.median(enc_times), 3),
+        "check_s": round(statistics.median(chk_times), 3),
     }
     return _check_regression(line)
 
 
 REGRESSION_THRESHOLD = 0.20
-"""Headline medians more than this far below the previous BENCH file's
-fail loudly in the report."""
+"""Headline medians more than this far below the best of the last
+GATE_WINDOW rounds fail loudly in the report."""
+
+GATE_WINDOW = 3
+"""How many previous rounds the gate considers. Comparing against the
+BEST of the window (not just the previous round) closes the
+two-consecutive-15%-drops hole: the second drop is still measured
+against the pre-bleed value."""
 
 
-def _previous_headline():
-    """The last recorded headline line: the driver stores each round's
-    final JSON line as `parsed` in BENCH_r<NN>.json next to this
-    script."""
+def _bench_rounds():
+    """[(round, headline-dict, source)] from the driver's BENCH_r<NN>
+    artifacts, round order."""
     import glob
     import re
 
@@ -288,43 +307,77 @@ def _previous_headline():
         glob.glob(os.path.join(here, "BENCH_r*.json")),
         key=lambda p: int(re.search(r"r(\d+)", os.path.basename(p))
                           .group(1)))
-    for p in reversed(paths):
+    out = []
+    for p in paths:
         try:
             with open(p) as f:
                 parsed = json.load(f).get("parsed")
             if isinstance(parsed, dict) and parsed.get("value"):
-                return parsed, os.path.basename(p)
+                rnd = int(re.search(r"r(\d+)", os.path.basename(p))
+                          .group(1))
+                out.append((rnd, parsed, os.path.basename(p)))
         except (OSError, ValueError):
             continue
-    return None, None
+    return out
+
+
+def _ledger_path():
+    from jepsen_tpu import ledger
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    return os.path.join(here, ledger.LEDGER_FILE)
+
+
+def _previous_headlines(metric):
+    """The last GATE_WINDOW rounds' headline values for `metric`,
+    merged from the perf ledger and the BENCH_r artifacts (the ledger
+    wins when both carry a round — it's written by this script, the
+    artifacts by the driver). Returns [(round, value, source)]."""
+    from jepsen_tpu import ledger
+
+    by_round = {}
+    for rnd, parsed, src in _bench_rounds():
+        if parsed.get("metric") == metric:
+            by_round[rnd] = (parsed["value"], src)
+    for e in ledger.read_entries(_ledger_path()):
+        hl = e.get("headline") or {}
+        if (hl.get("metric") == metric
+                and isinstance(hl.get("value"), (int, float))
+                and isinstance(e.get("round"), int)):
+            by_round[e["round"]] = (hl["value"], "ledger")
+    rounds = sorted(by_round)[-GATE_WINDOW:]
+    return [(r, by_round[r][0], by_round[r][1]) for r in rounds]
 
 
 def _check_regression(line):
-    """Compares the new headline median against the previous BENCH
-    round; a >20% drop fails loudly (REGRESSION banner on stderr +
-    regression fields in the JSON, so the report can't read a real
-    drop as routine noise). Skipped when history sizes differ
-    (BENCH_OPS smoke runs aren't comparable)."""
-    prev, src = _previous_headline()
+    """Compares the new headline median against the BEST of the last
+    GATE_WINDOW rounds (ledger + BENCH artifacts); a >20% drop fails
+    loudly (REGRESSION banner on stderr + regression fields in the
+    JSON, so the report can't read a real drop as routine noise).
+    Skipped when history sizes differ (BENCH_OPS smoke runs aren't
+    comparable)."""
+    prev = _previous_headlines(line.get("metric"))
     if not prev:
+        _log("regression check skipped: no previous round measured "
+             f"{line.get('metric')!r}")
         return line
-    if prev.get("metric") != line.get("metric"):
-        _log(f"regression check skipped: previous headline measured "
-             f"{prev.get('metric')!r}")
-        return line
-    ratio = line["value"] / prev["value"]
-    line["prev_value"] = prev["value"]
+    best_round, best, src = max(prev, key=lambda t: t[1])
+    ratio = line["value"] / best
+    line["prev_value"] = best
+    line["prev_rounds"] = [r for r, _v, _s in prev]
     line["vs_prev"] = round(ratio, 3)
     if ratio < 1.0 - REGRESSION_THRESHOLD:
         line["regression"] = True
         _log("!!! REGRESSION: headline "
              f"{line['value']} {line.get('unit')} is "
-             f"{(1 - ratio) * 100:.1f}% below the previous round's "
-             f"{prev['value']} ({src}); per-rep times "
+             f"{(1 - ratio) * 100:.1f}% below the best of the last "
+             f"{len(prev)} rounds ({best} at r{best_round:02d}, "
+             f"{src}); per-rep times "
              f"{line.get('runs_s')} spread {line.get('spread')}")
     else:
-        _log(f"regression check: {ratio:.2f}x vs previous round "
-             f"({src})")
+        _log(f"regression check: {ratio:.2f}x vs best of last "
+             f"{len(prev)} rounds ({best} at r{best_round:02d}, "
+             f"{src})")
     return line
 
 
@@ -634,6 +687,132 @@ def _telemetry_lines():
     return lines
 
 
+# bench-line metric substrings -> ledger kernel names (value direction
+# rides along: ops/s-style lines are higher-is-better)
+_KERNEL_METRICS = (
+    ("elle list-append", "elle-append", True),
+    ("elle rw-register", "elle-rw", True),
+    ("bank balance-conservation", "bank", True),
+    ("ensemble linearizability", "wgl-ensemble", True),
+    ("time-to-first-anomaly", "anomaly", False),
+)
+
+
+def _ledger_entry(lines, headline):
+    """One perf-ledger entry for this round: the headline plus a
+    per-kernel breakdown (config lines mapped through _KERNEL_METRICS,
+    and the headline's own encode/check split), so the slow-bleed
+    detector can attribute a drift to wgl-vs-elle-vs-encode."""
+    from jepsen_tpu import ledger
+
+    kernels = {}
+    for ln in lines:
+        metric = str(ln.get("metric", ""))
+        for sub, name, higher in _KERNEL_METRICS:
+            if sub in metric and isinstance(ln.get("value"),
+                                            (int, float)):
+                kernels[name] = {"value": ln["value"],
+                                 "unit": ln.get("unit"),
+                                 "higher_is_better": higher}
+    for field, name in (("encode_s", "encode"),
+                        ("check_s", "wgl-segmented")):
+        if isinstance(headline.get(field), (int, float)):
+            kernels[name] = {"value": headline[field], "unit": "s",
+                             "higher_is_better": False}
+    entries = ledger.read_entries(_ledger_path())
+    floor = max((r for r, _p, _s in _bench_rounds()), default=0)
+    return {
+        "round": ledger.next_round(entries, floor=floor),
+        "kind": "bench",
+        "headline": {k: headline.get(k) for k in
+                     ("metric", "value", "unit", "runs_s", "spread")},
+        "kernels": kernels,
+    }
+
+
+def _ledger_update(lines, headline):
+    """Appends this round to bench_ledger.jsonl and runs the
+    slow-bleed detector over the whole ledger: a kernel whose EWMA has
+    drifted >15% below its recent best gets a SLOW-BLEED banner and a
+    `slow_bleed` field on the headline line — the gradual regressions
+    the per-round >20% gate can't see. Skipped for BENCH_OPS smoke
+    runs (incomparable sizes would poison the series)."""
+    from jepsen_tpu import ledger
+
+    try:
+        entry = _ledger_entry(lines, headline)
+        path = _ledger_path()
+        ledger.append_entry(path, entry)
+        entries = ledger.read_entries(path)
+        ledger.validate_entries(entries)
+        _log(f"ledger: appended round {entry['round']} "
+             f"({len(entries)} entries)")
+        verdicts = ledger.detect(entries)
+        bleeding = {k: v for k, v in verdicts.items()
+                    if v.get("bleeding")}
+        for name, v in sorted(bleeding.items()):
+            _log(f"!!! SLOW-BLEED: {name} EWMA is "
+                 f"{v['drop'] * 100:.1f}% below its best of the last "
+                 f"{ledger.BEST_WINDOW} rounds (the per-round "
+                 f"{REGRESSION_THRESHOLD:.0%} gate never tripped)")
+        if bleeding:
+            headline["slow_bleed"] = {
+                k: v["drop"] for k, v in sorted(bleeding.items())}
+    except Exception as e:  # noqa: BLE001 — ledger must not sink bench
+        _log(f"ledger update failed: {e!r}")
+    return headline
+
+
+def _multichip_lines():
+    """Scaling-attribution line from the newest MULTICHIP_r*.json: the
+    dry run prints `parallel_efficiency {...}` into its tail
+    (__graft_entry__.dryrun_multichip); bench re-checks it so a flat
+    mesh sweep fails loudly in every report, not just the sweep's."""
+    import glob
+    import re
+
+    from jepsen_tpu.tpu import profiler
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    paths = sorted(
+        glob.glob(os.path.join(here, "MULTICHIP_r*.json")),
+        key=lambda p: int(re.search(r"r(\d+)", os.path.basename(p))
+                          .group(1)))
+    eff = None
+    src = None
+    for p in reversed(paths):
+        try:
+            with open(p) as f:
+                doc = json.load(f)
+            raw = doc.get("parallel_efficiency")
+            if raw is None:
+                m = re.search(r"parallel_efficiency (\{[^}\n]*\})",
+                              str(doc.get("tail", "")))
+                raw = json.loads(m.group(1)) if m else None
+            if isinstance(raw, dict) and raw:
+                eff = {int(k): float(v) for k, v in raw.items()}
+                src = os.path.basename(p)
+                break
+        except (OSError, ValueError):
+            continue
+    if not eff:
+        return []
+    bad = profiler.check_efficiency(eff, log=lambda m: _log(
+        f"!!! {src}: {m}"))
+    n_max = max(eff)
+    _log(f"multichip efficiency ({src}): " + " ".join(
+        f"mesh{n}={e}" for n, e in sorted(eff.items())))
+    return [{
+        "metric": f"multichip parallel efficiency at {n_max} devices "
+                  f"(mesh1_time / (mesh{n_max}_time x {n_max}), "
+                  f"from {src})",
+        "value": eff[n_max],
+        "unit": "fraction",
+        "vs_baseline": round(eff[n_max] / 1.0, 4),
+        "flat_mesh": bool(bad),
+    }]
+
+
 def _enable_compile_cache():
     """Persistent XLA compilation cache: repeat bench runs skip the
     ~35s one-time kernel compiles."""
@@ -679,6 +858,14 @@ def main():
                 _log(f"{fn.__name__} failed: {e!r}")
     headline = bench_headline(n_events)
     lines.extend(_telemetry_lines())
+    try:
+        lines.extend(_multichip_lines())
+    except Exception as e:  # noqa: BLE001 — attribution lines are extras
+        _log(f"multichip lines failed: {e!r}")
+    if not small and not os.environ.get("BENCH_NO_LEDGER"):
+        # cross-run perf ledger + slow-bleed detection (full-size
+        # rounds only: smoke-run numbers would poison the series)
+        headline = _ledger_update(lines, headline)
     lines.append(headline)  # the driver records the LAST line
     for ln in lines:
         print(json.dumps(ln))

@@ -119,6 +119,11 @@ def make_test(opts: dict) -> dict:
         # per-node circuit breakers: a dead node degrades the run
         # instead of aborting it (doc/robustness.md)
         test["quarantine?"] = True
+    if opts.get("xla_trace"):
+        # capture an XLA profiler trace (xplane protobufs, viewable in
+        # xprof/TensorBoard) of the analysis phase into the run's
+        # store dir (doc/observability.md)
+        test["xla-trace?"] = True
     for k, v in w.items():
         if k not in ("generator", "checker", "final_generator"):
             test[k] = v
@@ -184,6 +189,10 @@ def _workload_opt(p):
                    help="Quarantine persistently unreachable nodes "
                         "and continue the run :degraded instead of "
                         "aborting (doc/robustness.md).")
+    p.add_argument("--xla-trace", action="store_true",
+                   help="Drop an XLA profiler trace of the analysis "
+                        "phase into the run's store dir "
+                        "(<run>/xla-trace, xprof/TensorBoard format).")
     return p
 
 
@@ -195,6 +204,7 @@ def main(argv=None) -> None:
                                      parser_fn=_workload_opt))
     commands.update(cli.serve_cmd())
     commands.update(cli.telemetry_cmd())
+    commands.update(cli.profile_cmd())
     commands.update(cli.trace_cmd())
     commands.update(cli.analyze_cmd(make_test))
     cli.run_cli(commands, argv)

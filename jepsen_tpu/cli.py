@@ -261,9 +261,19 @@ def test_all_cmd(tests_fn, parser_fn=None, opt_fn=None) -> dict:
 def telemetry_cmd() -> dict:
     """A 'telemetry' subcommand: prints the span-tree + metrics
     summary for a stored run (its telemetry.jsonl / metrics.json
-    artifacts; see doc/observability.md)."""
+    artifacts; see doc/observability.md). --min-ms / --top prune the
+    span tree (ancestors of kept spans survive) so per-launch kernel
+    records don't drown the phase view."""
     def build(p):
-        return _store_run_opts(p)
+        _store_run_opts(p)
+        p.add_argument("--min-ms", type=float, default=None,
+                       metavar="MS",
+                       help="Hide spans shorter than this many "
+                            "milliseconds.")
+        p.add_argument("--top", type=int, default=None, metavar="N",
+                       help="Show only the N longest spans (plus "
+                            "their ancestors).")
+        return p
 
     def run(options):
         from . import store as jstore
@@ -279,10 +289,41 @@ def telemetry_cmd() -> dict:
                   "(run predates the telemetry layer?)")
             return 1
         print(f"# {d.resolve()}\n")
-        print(rtel.telemetry_text(events, metrics))
+        print(rtel.telemetry_text(events, metrics,
+                                  min_ms=options.min_ms,
+                                  top=options.top))
         return 0
 
     return {"telemetry": {"parser_fn": build, "run": run}}
+
+
+def profile_cmd() -> dict:
+    """A 'profile' subcommand: the per-kernel device-performance table
+    for a stored run — launches, compile-cache hit rate, FLOPs, bytes
+    accessed, peak device memory, and the wall/device phase split —
+    from the run's metrics.json + telemetry.jsonl launch records
+    (jepsen_tpu.tpu.profiler; doc/observability.md)."""
+    def build(p):
+        return _store_run_opts(p)
+
+    def run(options):
+        from . import store as jstore
+        from .reports import profile as rprofile
+
+        d = _resolve_stored_run(options)
+        if d is None:
+            print(f"no such stored test: {options.test}")
+            return 254
+        events, metrics = jstore.load_telemetry(d)
+        if not events and metrics is None:
+            print(f"no telemetry recorded under {d} "
+                  "(run predates the profiler?)")
+            return 1
+        print(f"# {d.resolve()}\n")
+        print(rprofile.profile_text(events, metrics))
+        return 0
+
+    return {"profile": {"parser_fn": build, "run": run}}
 
 
 def _resolve_stored_run(options):

@@ -343,6 +343,11 @@ def analyze(test: dict, store_ctx=None, extra_opts: dict | None = None
     if trace_dir is None and store_ctx is not None and test.get(
             "profile?"):
         trace_dir = store_ctx.path(test, "xprof")
+    if trace_dir is None and store_ctx is not None and test.get(
+            "xla-trace?"):
+        # the --xla-trace CLI flag: an XLA profiler trace of the
+        # analysis phase (every kernel launch) lands in the store dir
+        trace_dir = store_ctx.path(test, "xla-trace")
     # a hung non-composed checker gets the same wall-clock bound the
     # Compose applies per sub-checker; composed checkers are bounded
     # individually inside (one outer bound would cap the whole set)
@@ -421,6 +426,13 @@ def run(test: dict) -> dict:
         # times); nothing in analysis reads the ambient origin itself.
         with util.with_relative_time():
             telemetry.reset()
+            try:
+                # per-launch device-profile records are scoped per run
+                # like the telemetry they mirror into
+                from .tpu import profiler as jprofiler
+                jprofiler.reset()
+            except ImportError:
+                pass
             # per-op causal tracing is opt-in (test["trace?"]); when a
             # store exists the recorder streams optrace.jsonl into it
             # as spans complete (crash-tolerant like telemetry.jsonl)

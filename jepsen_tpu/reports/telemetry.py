@@ -47,9 +47,47 @@ def span_tree(events) -> list[tuple[int, dict]]:
     return rows
 
 
-def span_tree_lines(events) -> list[str]:
+def filter_spans(events, min_ms: float | None = None,
+                 top: int | None = None) -> list:
+    """Span-volume control for deep kernel traces (per-launch records
+    multiply span counts): keeps spans at least `min_ms` long and/or
+    the `top` N longest, PLUS every ancestor of a kept span (so the
+    phase context survives the pruning). Open spans always survive —
+    they're what a live run is doing right now. No-op when neither
+    filter is given."""
+    if min_ms is None and top is None:
+        return list(events)
+    events = [e for e in events if "t0" in e]
+    by_id = {e.get("id"): e for e in events}
+
+    def dur_ms(e):
+        return (e["t1"] - e["t0"]) / 1e6 if "t1" in e else None
+
+    seeds = [e for e in events
+             if dur_ms(e) is None
+             or min_ms is None or dur_ms(e) >= min_ms]
+    if top is not None:
+        closed = sorted((e for e in seeds if dur_ms(e) is not None),
+                        key=dur_ms, reverse=True)[:max(top, 0)]
+        seeds = [e for e in seeds if dur_ms(e) is None] + closed
+    keep = set()
+    for e in seeds:
+        sid = e.get("id")
+        # walk ancestors; the depth bound guards a parent cycle in a
+        # corrupt artifact
+        for _ in range(64):
+            if sid is None or sid in keep:
+                break
+            keep.add(sid)
+            parent = by_id.get(sid)
+            sid = parent.get("parent") if parent else None
+    return [e for e in events if e.get("id") in keep]
+
+
+def span_tree_lines(events, min_ms: float | None = None,
+                    top: int | None = None) -> list[str]:
     lines = []
-    for depth, e in span_tree(events):
+    for depth, e in span_tree(filter_spans(events, min_ms, top)):
         dur = _ms(e["t1"] - e["t0"]) if "t1" in e else "(open)"
         extra = ""
         if e.get("attrs"):
@@ -81,11 +119,18 @@ def _metric_rows(metrics: dict) -> list[tuple[str, str, str]]:
     return rows
 
 
-def telemetry_text(events, metrics: dict | None) -> str:
-    """The CLI `telemetry` subcommand's output: span tree, then the
-    aggregated counters/gauges/span table."""
+def telemetry_text(events, metrics: dict | None,
+                   min_ms: float | None = None,
+                   top: int | None = None) -> str:
+    """The CLI `telemetry` subcommand's output: span tree (optionally
+    pruned by --min-ms / --top, see filter_spans), then the aggregated
+    counters/gauges/span table."""
     out = ["# Spans", ""]
-    lines = span_tree_lines(events)
+    lines = span_tree_lines(events, min_ms=min_ms, top=top)
+    if (min_ms is not None or top is not None) and events:
+        shown = len(lines)
+        out.insert(1, f"(filtered: showing {shown} of "
+                      f"{sum(1 for e in events if 't0' in e)} spans)")
     out.extend(lines or ["(no spans recorded)"])
     out += ["", "# Metrics", ""]
     rows = _metric_rows(metrics or {})

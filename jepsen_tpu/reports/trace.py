@@ -22,6 +22,9 @@ events, per the trace-event spec):
            instant markers for reconnects/partition events, all on
            the same linear clock so they nest by containment.
   nemesis  one track per nemesis spec, a slice per activation window
+  device   one track per compiled kernel (wgl, scc, ...): each launch
+           record (jepsen_tpu.tpu.profiler) is a slice carrying its
+           FLOPs/bytes/phase-split attrs
 
 CLI: `python -m jepsen_tpu trace <run>` writes `trace.json` into the
 run's store directory (see doc/observability.md for the walkthrough);
@@ -48,6 +51,7 @@ _CNAME = {"ok": "good", "info": "bad", "fail": "terrible"}
 _PID_HARNESS = 1
 _PID_CLIENTS = 2
 _PID_NEMESIS = 3
+_PID_DEVICE = 4
 
 
 def _us(ns: int) -> float:
@@ -83,12 +87,17 @@ def _process_meta(events: list, pid: int, name: str) -> None:
 
 
 def _span_events(events: list, spans) -> int:
-    """Telemetry spans as one flame-track per recorder thread."""
+    """Telemetry spans as one flame-track per recorder thread. Device
+    launch records (`kernel:` spans) are excluded here — they get
+    their own per-kernel device tracks (_device_events) instead of
+    hiding inside the harness flame."""
     _process_meta(events, _PID_HARNESS, "harness")
     tids = _Tids(events, _PID_HARNESS, sort_index=0)
     n = 0
     for s in spans:
         if "t0" not in s or "t1" not in s:
+            continue
+        if str(s.get("name", "")).startswith("kernel:"):
             continue
         ev = {"ph": "X", "cat": "span",
               "name": str(s.get("name", "?")),
@@ -98,6 +107,37 @@ def _span_events(events: list, spans) -> int:
               "dur": max(_us(s["t1"] - s["t0"]), 0.001)}
         if s.get("attrs"):
             ev["args"] = {k: repr(v) for k, v in s["attrs"].items()}
+        events.append(ev)
+        n += 1
+    return n
+
+
+def _device_events(events: list, spans) -> int:
+    """Device-launch records (the profiler's `kernel:<name>` telemetry
+    spans) as one track per kernel: each launch is a slice carrying
+    its cost/phase attrs (FLOPs, bytes, compile/compute split), so a
+    kernel launch lines up against the checker phase and the ops it
+    was checking on the shared clock."""
+    launches = [s for s in spans
+                if str(s.get("name", "")).startswith("kernel:")
+                and "t0" in s and "t1" in s]
+    if not launches:
+        return 0
+    _process_meta(events, _PID_DEVICE, "device")
+    tids = _Tids(events, _PID_DEVICE, sort_index=3)
+    n = 0
+    for s in launches:
+        kernel = str(s["name"])[len("kernel:"):]
+        ev = {"ph": "X", "cat": "kernel",
+              "name": kernel,
+              "pid": _PID_DEVICE,
+              "tid": tids.tid(kernel),
+              "ts": _us(s["t0"]),
+              "dur": max(_us(s["t1"] - s["t0"]), 0.001)}
+        if s.get("attrs"):
+            ev["args"] = {k: (v if isinstance(v, (int, float, str))
+                              else repr(v))
+                          for k, v in s["attrs"].items()}
         events.append(ev)
         n += 1
     return n
@@ -244,11 +284,13 @@ def chrome_trace(test: dict | None, history, spans,
     ops_filter = expand_op_filter(history, ops)
     events: list[dict] = []
     n_spans = _span_events(events, spans or [])
+    n_dev = _device_events(events, spans or [])
     tids = _op_events(events, history, ops_filter)
     n_rec = _optrace_events(events, tids, optrace, ops_filter)
     n_nem = _nemesis_events(events, test, history)
-    logger.info("trace: %d spans, %d optrace records, %d nemesis "
-                "windows", n_spans, n_rec, n_nem)
+    logger.info("trace: %d spans, %d device launches, %d optrace "
+                "records, %d nemesis windows", n_spans, n_dev, n_rec,
+                n_nem)
     return {"traceEvents": events,
             "displayTimeUnit": "ms",
             "otherData": {"source": "jepsen_tpu",

@@ -122,6 +122,32 @@ class Telemetry:
                 if self._epoch == epoch0:
                     self._spans.append(rec)
 
+    def record_span(self, name: str, t0: int, t1: int,
+                    attrs: dict | None = None,
+                    epoch: int | None = None) -> dict | None:
+        """Appends an already-timed span (no nesting, parent=None) —
+        the path device-launch records take: the profiler times the
+        launch phases itself and mirrors the completed interval here so
+        it lands in telemetry.jsonl / the Perfetto device track.
+        `epoch` (captured via .epoch when the interval STARTED) applies
+        the same straggler guard as span(): a reset() between capture
+        and append means t0/t1 were measured against a previous run's
+        clock origin, and the span is dropped, not misfiled."""
+        if not self.enabled:
+            return None
+        rec: dict = {"name": name, "parent": None,
+                     "thread": threading.current_thread().name,
+                     "t0": int(t0), "t1": int(t1)}
+        if attrs:
+            rec["attrs"] = dict(attrs)
+        with self._lock:
+            if epoch is not None and epoch != self._epoch:
+                return None
+            self._next_id += 1
+            rec["id"] = self._next_id
+            self._spans.append(rec)
+        return rec
+
     def timed(self, name: str) -> Callable:
         """Decorator form of span()."""
 
@@ -276,6 +302,12 @@ def gauge_max(name: str, value) -> None:
     _global.gauge_max(name, value)
 
 
+def record_span(name: str, t0: int, t1: int,
+                attrs: dict | None = None,
+                epoch: int | None = None) -> dict | None:
+    return _global.record_span(name, t0, t1, attrs, epoch)
+
+
 def timed(name: str) -> Callable:
     return _global.timed(name)
 
@@ -325,3 +357,39 @@ def read_metrics(path) -> dict | None:
             return json.load(f)
     except (OSError, ValueError):
         return None
+
+
+def validate_metrics(metrics: dict) -> int:
+    """Schema check for a metrics.json document (the tracing.
+    validate_records analog for the metrics artifact, run in tier-1):
+    the three sections exist with the right shapes, every span
+    aggregate carries non-negative integer count/total_ns/max_ns with
+    max <= total, and counters are integers. Returns the total entry
+    count; raises ValueError on the first violation."""
+    if not isinstance(metrics, dict):
+        raise ValueError("metrics must be a dict")
+    for section in ("spans", "counters", "gauges"):
+        if not isinstance(metrics.get(section), dict):
+            raise ValueError(f"metrics missing {section!r} dict")
+    n = 0
+    for name, agg in metrics["spans"].items():
+        if not isinstance(agg, dict):
+            raise ValueError(f"span {name!r}: aggregate must be a dict")
+        for key in ("count", "total_ns", "max_ns"):
+            v = agg.get(key)
+            if not isinstance(v, int) or v < 0:
+                raise ValueError(
+                    f"span {name!r}: bad {key}: {v!r}")
+        if agg["max_ns"] > agg["total_ns"]:
+            raise ValueError(
+                f"span {name!r}: max_ns {agg['max_ns']} exceeds "
+                f"total_ns {agg['total_ns']}")
+        if agg["count"] == 0 and agg["total_ns"]:
+            raise ValueError(f"span {name!r}: time without count")
+        n += 1
+    for name, v in metrics["counters"].items():
+        if not isinstance(v, int):
+            raise ValueError(f"counter {name!r}: non-integer {v!r}")
+        n += 1
+    n += len(metrics["gauges"])
+    return n

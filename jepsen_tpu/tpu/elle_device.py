@@ -31,6 +31,7 @@ import numpy as np
 from .. import history as h
 from .. import telemetry
 from ..history import History
+from . import profiler
 from . import scc as scc_mod
 from .elle import (EDGE_NAMES, PROC, RT, RW, WR, WW, Txn, _classify,
                    _find_cycle, collect, order_edges_from_arrays)
@@ -614,18 +615,26 @@ def check_list_append_device(hist, device: bool = True) -> dict:
     Unvectorizable when the history can't be interned."""
     if not isinstance(hist, History):
         hist = History(hist)
+    prof = profiler.get()
+    rec = prof.begin("elle-append")
     with telemetry.span("elle:list-append") as sp:
-        a = DeviceAppendAnalysis(hist, device=device)
+        with prof.phase(rec, "encode_ns"):
+            # host side: flatten + edge inference (the SCC launches
+            # inside cycle_anomalies_arrays profile themselves)
+            a = DeviceAppendAnalysis(hist, device=device)
         if sp is not None:
             sp["attrs"] = {"txns": a.flat.n,
                            "edges": int(len(a.edge_src))}
     telemetry.count("elle.txns", a.flat.n)
     telemetry.count("elle.edges", int(len(a.edge_src)))
+    rec.update(txns=a.flat.n, edges=int(len(a.edge_src)))
     anomalies = dict(a.anomalies)
-    for name, ws in cycle_anomalies_arrays(
-            a.flat.n, a.edge_src, a.edge_dst, a.edge_ty, a._op,
-            device=device).items():
-        anomalies[name] = ws
+    with prof.phase(rec, "compute_ns"):
+        for name, ws in cycle_anomalies_arrays(
+                a.flat.n, a.edge_src, a.edge_dst, a.edge_ty, a._op,
+                device=device).items():
+            anomalies[name] = ws
+    prof.finish(rec)
     return {
         "valid?": not anomalies,
         "anomaly-types": sorted(anomalies.keys()),
@@ -968,18 +977,24 @@ def check_rw_register_device(hist, device: bool = True) -> dict:
     Unvectorizable when the history can't be interned."""
     if not isinstance(hist, History):
         hist = History(hist)
+    prof = profiler.get()
+    rec = prof.begin("elle-rw")
     with telemetry.span("elle:rw-register") as sp:
-        a = DeviceRwAnalysis(hist, device=device)
+        with prof.phase(rec, "encode_ns"):
+            a = DeviceRwAnalysis(hist, device=device)
         if sp is not None:
             sp["attrs"] = {"txns": a.flat.n,
                            "edges": int(len(a.edge_src))}
     telemetry.count("elle.txns", a.flat.n)
     telemetry.count("elle.edges", int(len(a.edge_src)))
+    rec.update(txns=a.flat.n, edges=int(len(a.edge_src)))
     anomalies = dict(a.anomalies)
-    for name, ws in cycle_anomalies_arrays(
-            a.flat.n, a.edge_src, a.edge_dst, a.edge_ty, a._op,
-            device=device).items():
-        anomalies[name] = ws
+    with prof.phase(rec, "compute_ns"):
+        for name, ws in cycle_anomalies_arrays(
+                a.flat.n, a.edge_src, a.edge_dst, a.edge_ty, a._op,
+                device=device).items():
+            anomalies[name] = ws
+    prof.finish(rec)
     return {
         "valid?": not anomalies,
         "anomaly-types": sorted(anomalies.keys()),

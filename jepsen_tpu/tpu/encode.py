@@ -166,7 +166,24 @@ def entries(hist: History) -> list[tuple[int, int, bool, Op]]:
 def encode(model, hist: History, max_states: int = 4096) -> Encoded:
     """Compiles (model, history) into an Encoded. Raises EncodingError if
     the reachable state space exceeds max_states or the model declares
-    itself non-tabulable (step() depends on more than op.f/op.value)."""
+    itself non-tabulable (step() depends on more than op.f/op.value).
+
+    Host-encode time is the first phase of every kernel launch
+    pipeline, so it's accounted to the device profiler (aggregate
+    `profiler.encode.*` counters — ensembles encode thousands of
+    histories, so no per-call records)."""
+    from time import monotonic_ns
+
+    from . import profiler
+
+    t0 = monotonic_ns()
+    enc = _encode(model, hist, max_states)
+    profiler.get().record_host("encode", monotonic_ns() - t0,
+                               entries=enc.m)
+    return enc
+
+
+def _encode(model, hist: History, max_states: int) -> Encoded:
     if not getattr(model, "tabulable", True):
         raise EncodingError(f"{type(model).__name__} is not tabulable")
     ents = entries(hist)

@@ -20,6 +20,7 @@ import numpy as np
 from functools import lru_cache
 
 from .. import telemetry
+from . import profiler
 from .encode import Encoded
 from .wgl import PackedBatch, _drain, _kernel, _next_pow2, _timed_launch
 
@@ -94,7 +95,19 @@ def check_batch_sharded(encs: Sequence[Encoded], mesh=None, W: int = 32,
               W, F, pb.M + 4, reach)
     telemetry.count("wgl.ensemble.launches")
     telemetry.count("wgl.kernel.rows", len(padded))
-    out = _timed_launch(bucket, lambda: fn(*args))
+    # per-device work attribution: entries of search work landing on
+    # each chip's row shard, plus a load-balance ratio (mean/max work
+    # — 1.0 means a perfectly even mesh; the figure that, with the
+    # replicated-segment H2D cost, explains a flat device sweep)
+    work = profiler.device_work(row_seg, pb.m[:pb.B], n_dev)
+    balance = (round(float(np.mean(work)) / max(work), 4)
+               if work and max(work) else None)
+    meta = {"rows": len(padded), "batch": pb.B, "m": pb.M,
+            "states": pb.S, "devices": n_dev,
+            "device_entries": work, "balance": balance}
+    out = _timed_launch(bucket, lambda: fn(*args),
+                        kernel="wgl-sharded",
+                        lower=lambda: fn.lower(*args), meta=meta)
     if reach:
         mask, unk = _drain(out, reach=True)
         return mask[:n_rows], unk[:n_rows]

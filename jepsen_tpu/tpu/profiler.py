@@ -1,0 +1,397 @@
+"""Device-performance profiling for compiled-kernel launches.
+
+telemetry.py answers "where did the run spend its time?"; this module
+answers "what did the device DO with it?". Every compiled-kernel launch
+site (wgl batched search, the mesh-sharded ensemble path, the SCC
+coloring kernel, the elle device engines, plus the host-side encode and
+pack stages that feed them) reports a per-launch record:
+
+  - lowered-HLO cost analysis: FLOPs, bytes accessed (from
+    jax.stages.Lowered.cost_analysis()) and peak device memory
+    (argument + output + temp sizes from Compiled.memory_analysis()),
+    computed ONCE per compile bucket and attached to every launch of
+    that bucket;
+  - the wall vs device split: host encode / H2D transfer / dispatch /
+    device compute / D2H readback, each phase timed separately;
+  - compile-cache accounting per shape bucket (hooked into the
+    existing wgl._compiled_buckets claim), so the profile shows hit
+    rates, not just compile totals;
+  - for mesh-sharded launches: per-device work attribution (entries of
+    search work landing on each chip) and a load-balance figure — the
+    data that explains a flat device-count sweep.
+
+Records flow through the existing observability fabric: each finished
+launch mirrors into telemetry as a `kernel:<name>` span (so it lands in
+telemetry.jsonl and the Perfetto export's device track) and as
+`profiler.<kernel>.*` counters/gauges (so metrics.json carries the
+aggregate the `profile` CLI and web section render). The recorder is
+always on and adds two dict updates per launch; cost analysis costs one
+lowering per NEW bucket only (JEPSEN_TPU_PROFILE_COST=0 disables it).
+
+Cross-run trending lives in jepsen_tpu.ledger (the bench perf ledger);
+parallel_efficiency() below is the shared scaling metric both the
+multichip dry run and bench report.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from contextlib import contextmanager
+from typing import Any, Callable
+
+from .. import telemetry, util
+
+logger = logging.getLogger(__name__)
+
+# Launch-phase keys, in pipeline order. Every *_ns field of a record
+# that isn't one of these is still additive host time (compile_ns).
+PHASES = ("encode_ns", "h2d_ns", "dispatch_ns", "compute_ns", "d2h_ns")
+
+# Cost fields attached per bucket (None when the backend can't say).
+COST_FIELDS = ("flops", "bytes_accessed", "peak_memory_bytes")
+
+_COST_ENABLED = os.environ.get("JEPSEN_TPU_PROFILE_COST", "1") != "0"
+
+
+def _memory_analysis_enabled() -> bool:
+    """Whether peak-memory stats are worth their price. flops/bytes
+    come from Lowered.cost_analysis() (no compile), but
+    memory_analysis needs a Compiled — and Lowered.compile() does NOT
+    reuse the jit dispatch path's executable, so it's a second full
+    XLA compile per fresh bucket unless something makes it cheap:
+    the CPU backend (sub-second compiles) or a persistent compilation
+    cache serving it from disk (bench enables one; a ~35s TPU kernel
+    compile must not be paid twice). JEPSEN_TPU_PROFILE_MEMORY
+    overrides in either direction."""
+    env = os.environ.get("JEPSEN_TPU_PROFILE_MEMORY")
+    if env is not None:
+        return env != "0"
+    try:
+        import jax
+
+        if jax.default_backend() == "cpu":
+            return True
+        return bool(jax.config.jax_compilation_cache_dir)
+    except Exception:  # noqa: BLE001 — no jax, no memory stats
+        return False
+
+# Per-launch records are mirrored into telemetry individually only up
+# to this many launches per kernel per run; past it, only aggregates
+# accumulate (a 1024-history ensemble must not write 1024 span lines).
+MAX_MIRRORED_LAUNCHES = 64
+
+
+def _fresh_bucket_cost(lower: Callable, bucket_key) -> dict:
+    """FLOPs / bytes / peak memory for a newly-compiled bucket.
+
+    `lower` is a zero-arg thunk returning the jax.stages.Lowered for
+    the same (args, static) the launch used. flops/bytes read off the
+    Lowered alone (no compile); peak memory needs Lowered.compile(),
+    which is a second XLA compile of the bucket, so it only runs when
+    _memory_analysis_enabled() says that's cheap. Any failure (backend
+    without cost analysis, jax API drift) degrades to None fields —
+    profiling must never break a launch."""
+    cost: dict = {k: None for k in COST_FIELDS}
+    if not _COST_ENABLED or lower is None:
+        return cost
+    try:
+        lowered = lower()
+        ca = lowered.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        if isinstance(ca, dict):
+            if ca.get("flops") is not None:
+                cost["flops"] = float(ca["flops"])
+            if ca.get("bytes accessed") is not None:
+                cost["bytes_accessed"] = float(ca["bytes accessed"])
+        ma = None
+        if _memory_analysis_enabled():
+            try:
+                ma = lowered.compile().memory_analysis()
+            except Exception:  # noqa: BLE001 — memory is optional
+                ma = None
+        if ma is not None:
+            peak = sum(
+                int(getattr(ma, f, 0) or 0)
+                for f in ("argument_size_in_bytes",
+                          "output_size_in_bytes",
+                          "temp_size_in_bytes"))
+            if peak:
+                cost["peak_memory_bytes"] = peak
+    except Exception as e:  # noqa: BLE001 — profiling is best-effort
+        logger.debug("cost analysis failed for bucket %r: %r",
+                     bucket_key, e)
+    return cost
+
+
+class Profiler:
+    """Per-launch device-profile recorder. Thread-safe; one global
+    instance (get()) serves the process, tests may make their own.
+    `enabled=False` makes it a no-op recorder: records still open and
+    park (call sites mutate them unconditionally) but nothing is
+    aggregated, mirrored to telemetry, or cost-analyzed."""
+
+    def __init__(self, enabled: bool = True):
+        self.enabled = enabled
+        self._lock = threading.Lock()
+        self._records: list[dict] = []
+        self._pending: dict[int, dict] = {}   # id(device out) -> record
+        self._bucket_cost: dict[Any, dict] = {}
+        self._seen_buckets: dict[str, set] = {}
+        self.cache_stats: dict[str, dict] = {}
+
+    # -- launch records ----------------------------------------------------
+
+    def begin(self, kernel: str, bucket=None, **attrs) -> dict:
+        """Opens a launch record. `kernel` is a dot-free site name
+        ('wgl', 'scc', ...); `bucket` the compile-shape key."""
+        rec: dict = {"kernel": kernel.replace(".", "-"),
+                     "t0": util.relative_time_nanos(),
+                     # straggler guard: a telemetry.reset() (next run
+                     # starting) before this record finishes means its
+                     # clock origin is stale — _finish_locked drops it
+                     "_epoch": telemetry.get().epoch}
+        if bucket is not None:
+            rec["bucket"] = repr(bucket)
+        for k, v in attrs.items():
+            if v is not None:
+                rec[k] = v
+        return rec
+
+    @contextmanager
+    def phase(self, rec: dict | None, name: str):
+        """Times one pipeline phase (see PHASES) into the record."""
+        if rec is None:
+            yield
+            return
+        t0 = time.monotonic_ns()
+        try:
+            yield
+        finally:
+            rec[name] = rec.get(name, 0) + (time.monotonic_ns() - t0)
+
+    def cache_event(self, kernel: str, fresh: bool) -> None:
+        """One compile-cache lookup: miss (fresh bucket, compiling) or
+        hit (bucket already compiled this process)."""
+        if not self.enabled:
+            return
+        kernel = kernel.replace(".", "-")
+        with self._lock:
+            st = self.cache_stats.setdefault(kernel,
+                                             {"hits": 0, "misses": 0})
+            st["misses" if fresh else "hits"] += 1
+        telemetry.count(f"profiler.{kernel}.compile."
+                        + ("miss" if fresh else "hit"))
+
+    def bucket_fresh(self, site: str, bucket) -> bool:
+        """First-sighting test for launch sites without their own
+        compiled-bucket set (scc); counts the cache event too."""
+        with self._lock:
+            seen = self._seen_buckets.setdefault(site, set())
+            fresh = bucket not in seen
+            if fresh:
+                seen.add(bucket)
+        self.cache_event(site, fresh)
+        return fresh
+
+    def bucket_unclaim(self, site: str, bucket) -> None:
+        """Un-claims a bucket whose first launch failed (the analog of
+        wgl._timed_launch discarding its _compiled_buckets claim): the
+        next attempt really recompiles and must record a miss + fresh
+        compile_ns, not a phantom cache hit."""
+        with self._lock:
+            self._seen_buckets.get(site, set()).discard(bucket)
+
+    def bucket_cost(self, bucket, lower: Callable | None,
+                    fresh: bool) -> dict:
+        """The bucket's cost analysis: computed on first sight (when
+        `fresh`, right after its compile), served from cache after."""
+        if not self.enabled:
+            return {k: None for k in COST_FIELDS}
+        with self._lock:
+            cached = self._bucket_cost.get(bucket)
+        if cached is not None:
+            return cached
+        if not fresh and lower is None:
+            return {k: None for k in COST_FIELDS}
+        cost = _fresh_bucket_cost(lower, bucket)
+        with self._lock:
+            self._bucket_cost.setdefault(bucket, cost)
+        return cost
+
+    def attach(self, out, rec: dict) -> Any:
+        """Parks an open record until the launch's output is drained
+        (the async-dispatch handoff: _launch returns, _drain blocks).
+        Keyed by the output object's id — the caller holds the output
+        alive until drain, so the id can't be recycled underneath."""
+        if rec is None:
+            return out
+        with self._lock:
+            if len(self._pending) > 256:
+                # exception paths may abandon records; cap the parking
+                # lot, finalizing EVERY stray so all of them still
+                # aggregate (an in-flight launch loses its parked
+                # record to the sweep — its _drain finds None — but
+                # its dispatch-side phases are preserved here)
+                for stray in list(self._pending.values()):
+                    self._finish_locked(stray)
+                self._pending.clear()
+            self._pending[id(out)] = rec
+        return out
+
+    def take(self, out) -> dict | None:
+        with self._lock:
+            return self._pending.pop(id(out), None)
+
+    def finish(self, rec: dict | None) -> dict | None:
+        """Closes a record: stamps t1, mirrors it into telemetry (span
+        + per-kernel aggregate counters)."""
+        if rec is None:
+            return None
+        with self._lock:
+            self._finish_locked(rec)
+        return rec
+
+    def _finish_locked(self, rec: dict) -> None:
+        if "t1" in rec:
+            return
+        rec["t1"] = util.relative_time_nanos()
+        epoch = rec.pop("_epoch", None)
+        tel = telemetry.get()
+        if not self.enabled or (epoch is not None
+                                and epoch != tel.epoch):
+            # disabled recorder, or a straggler finishing after the
+            # next run began: its t0 was measured against the previous
+            # run's clock origin — dropping beats misfiling
+            return
+        self._records.append(rec)
+        k = rec["kernel"]
+        tel.count(f"profiler.{k}.launches")
+        wall = max(rec["t1"] - rec["t0"], 0)
+        tel.count(f"profiler.{k}.wall_ns", wall)
+        for ph in PHASES:
+            if rec.get(ph):
+                tel.count(f"profiler.{k}.{ph}", int(rec[ph]))
+        if rec.get("compile_ns"):
+            tel.count(f"profiler.{k}.compile_ns", int(rec["compile_ns"]))
+        if rec.get("iterations"):
+            tel.count(f"profiler.{k}.iterations", int(rec["iterations"]))
+        if rec.get("rows"):
+            tel.count(f"profiler.{k}.rows", int(rec["rows"]))
+        if rec.get("flops"):
+            tel.count(f"profiler.{k}.flops", int(rec["flops"]))
+        if rec.get("bytes_accessed"):
+            tel.count(f"profiler.{k}.bytes", int(rec["bytes_accessed"]))
+        if rec.get("peak_memory_bytes"):
+            tel.gauge_max(f"profiler.{k}.peak_memory_bytes",
+                          int(rec["peak_memory_bytes"]))
+        if rec.get("devices"):
+            tel.gauge_max(f"profiler.{k}.devices", int(rec["devices"]))
+        if rec.get("balance") is not None:
+            tel.gauge(f"profiler.{k}.balance", rec["balance"])
+        n_k = sum(1 for r in self._records if r["kernel"] == k)
+        if n_k <= MAX_MIRRORED_LAUNCHES:
+            attrs = {kk: v for kk, v in rec.items()
+                     if kk not in ("kernel", "t0", "t1")
+                     and v is not None}
+            tel.record_span(f"kernel:{k}", rec["t0"], rec["t1"], attrs,
+                            epoch=epoch)
+
+    # -- simple sites ------------------------------------------------------
+
+    def record_host(self, kernel: str, ns: int, **attrs) -> None:
+        """Aggregate-only accounting for cheap host stages (encode,
+        pack) that run thousands of times per analysis: counters only,
+        no per-call record."""
+        if not self.enabled:
+            return
+        k = kernel.replace(".", "-")
+        tel = telemetry.get()
+        tel.count(f"profiler.{k}.launches")
+        tel.count(f"profiler.{k}.wall_ns", int(ns))
+        tel.count(f"profiler.{k}.encode_ns", int(ns))
+        for name, v in attrs.items():
+            if isinstance(v, (int, float)) and v:
+                tel.count(f"profiler.{k}.{name}", int(v))
+
+    # -- views / lifecycle -------------------------------------------------
+
+    def records(self) -> list[dict]:
+        with self._lock:
+            return list(self._records)
+
+    def reset(self) -> None:
+        """Clears per-run state. Bucket cost/seen caches persist: they
+        mirror the process-level XLA compile cache, which a new run
+        still hits."""
+        with self._lock:
+            self._records = []
+            self._pending = {}
+            self.cache_stats = {}
+
+
+_global = Profiler()
+
+
+def get() -> Profiler:
+    return _global
+
+
+def reset() -> None:
+    _global.reset()
+
+
+# ---------------------------------------------------------------------------
+# Scaling attribution
+# ---------------------------------------------------------------------------
+
+def parallel_efficiency(times: dict[int, float]) -> dict[int, float]:
+    """Per-mesh-size parallel efficiency from a {n_devices: seconds}
+    sweep: eff(N) = T(1) / (T(N) * N). 1.0 = perfect linear scaling;
+    a flat sweep shows ~1/N — the MULTICHIP failure signature this
+    metric machine-checks (ROADMAP item 1)."""
+    t1 = times.get(1)
+    if not t1:
+        return {}
+    return {int(n): round(t1 / (t * n), 4)
+            for n, t in sorted(times.items()) if n >= 1 and t > 0}
+
+
+# Mesh sizes at least this big with efficiency below this floor get a
+# loud warning (bench + the multichip dry run both check it).
+EFFICIENCY_WARN_N = 4
+EFFICIENCY_WARN_FLOOR = 0.5
+
+
+def check_efficiency(eff: dict[int, float],
+                     log: Callable[[str], None] | None = None) -> list:
+    """Returns [(n, eff)] for every mesh size >= EFFICIENCY_WARN_N
+    scaling under the floor, logging each (the flat-sweep tripwire)."""
+    bad = [(n, e) for n, e in sorted(eff.items())
+           if n >= EFFICIENCY_WARN_N and e < EFFICIENCY_WARN_FLOOR]
+    emit = log or logger.warning
+    for n, e in bad:
+        emit(f"parallel efficiency at {n} devices is {e:.2f} "
+             f"(< {EFFICIENCY_WARN_FLOOR}): the mesh adds devices "
+             "without adding throughput")
+    return bad
+
+
+def device_work(row_seg, seg_entries, n_devices: int) -> list[int]:
+    """Entries of search work per device for a sharded launch: rows are
+    laid out contiguously over the mesh's batch axis, so device d owns
+    rows [d*per, (d+1)*per). seg_entries maps segment index -> entry
+    count (padding rows index one past the end and count 0)."""
+    import numpy as np
+
+    row_seg = np.asarray(row_seg)
+    ent = np.asarray(list(seg_entries) + [0])
+    per = max(len(row_seg) // max(n_devices, 1), 1)
+    work = []
+    for d in range(n_devices):
+        rows = row_seg[d * per:(d + 1) * per]
+        work.append(int(ent[np.clip(rows, 0, len(ent) - 1)].sum()))
+    return work

@@ -37,10 +37,12 @@ instead of recompiling per subset shape.
 from __future__ import annotations
 
 from functools import lru_cache
+from time import monotonic_ns
 
 import numpy as np
 
 from .. import telemetry
+from . import profiler
 
 # Caps: sweeps per fixpoint and outer peeling rounds. Each fixpoint
 # sweep is O(E) on device, so generous caps cost little; they exist to
@@ -168,8 +170,33 @@ def scc_device(n: int, src, dst, emask=None) -> np.ndarray | None:
     fn = _jitted_scc(n_pad, e_pad, SWEEP_CAP, ROUND_CAP)
     active = np.zeros(n_pad, dtype=bool)
     active[:n] = True
-    labels = np.asarray(fn(jnp.asarray(active), jnp.asarray(psrc),
-                           jnp.asarray(pdst), jnp.asarray(pmask)))
+    prof = profiler.get()
+    bucket = ("scc", n_pad, e_pad)
+    rec = prof.begin("scc", bucket=bucket, nodes=n, edges=len(src))
+    fresh = prof.bucket_fresh("scc", bucket)
+    t0 = monotonic_ns()
+    args = (jnp.asarray(active), jnp.asarray(psrc),
+            jnp.asarray(pdst), jnp.asarray(pmask))
+    rec["h2d_ns"] = monotonic_ns() - t0
+    try:
+        t0 = monotonic_ns()
+        dev = fn(*args)
+        rec["dispatch_ns"] = monotonic_ns() - t0
+        if fresh:
+            rec["compile_ns"] = rec["dispatch_ns"]
+        rec.update(prof.bucket_cost(bucket, lambda: fn.lower(*args),
+                                    fresh))
+        t0 = monotonic_ns()
+        labels = np.asarray(dev)
+        rec["compute_ns"] = monotonic_ns() - t0
+    except BaseException:
+        if fresh:
+            # failed first launch: release the claim so the retry's
+            # real recompile records a miss, not a phantom hit
+            prof.bucket_unclaim("scc", bucket)
+        raise
+    finally:
+        prof.finish(rec)
     if not labels[-1]:  # convergence flag (see _jitted_scc)
         return None
     return labels[:n]

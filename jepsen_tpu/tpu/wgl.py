@@ -43,6 +43,7 @@ from .. import history as h
 from .. import telemetry
 from ..checker import models as model_mod
 from ..history import History
+from . import profiler
 from .encode import INF, Encoded, EncodingError, encode
 
 BIG = int(INF)
@@ -395,6 +396,7 @@ class PackedBatch:
                  "st0", "M", "S", "B", "has_crashed")
 
     def __init__(self, encs: Sequence[Encoded]):
+        _pack_t0 = _time.monotonic_ns()
         B = len(encs)
         self.has_crashed = any(bool(e.crashed.any()) for e in encs)
         M = max((e.m for e in encs), default=0)
@@ -447,6 +449,10 @@ class PackedBatch:
             tel.gauge("wgl.batch.occupancy", round(used / slots, 4))
             tel.gauge("wgl.batch.padding-waste",
                       round(1 - used / slots, 4))
+        # host-side packing is part of the launch pipeline's "encode"
+        # wall time; aggregate-only (ensembles pack hundreds of times)
+        profiler.get().record_host(
+            "pack", _time.monotonic_ns() - _pack_t0, entries=used)
 
     def rows(self, rows: Sequence[tuple[int, int]]):
         """(row_seg, st0) int32 arrays for (segment, start-state) search
@@ -685,7 +691,8 @@ _compiled_buckets: set = set()
 _buckets_lock = _threading.Lock()
 
 
-def _timed_launch(bucket, dispatch):
+def _timed_launch(bucket, dispatch, kernel: str = "wgl", lower=None,
+                  meta: dict | None = None):
     """Runs a kernel-dispatch thunk with first-launch-per-bucket
     compile accounting. Shared by the single-device path below and the
     mesh-sharded path (tpu/ensemble.py); their bucket tuples differ in
@@ -693,7 +700,13 @@ def _timed_launch(bucket, dispatch):
     lock before measuring: concurrent checkers (compose fans out over
     a thread pool) racing on the same bucket must record one compile,
     not two — the loser's wait lands in execute time, where it
-    belongs."""
+    belongs.
+
+    Profiling: opens a per-launch profiler record (kernel/bucket/meta,
+    dispatch + compile phases, per-bucket cost analysis via `lower` —
+    a zero-arg thunk returning the jax Lowered) and parks it against
+    the dispatched output; _drain closes it with the device-wait and
+    readback phases."""
     import jax
 
     with _buckets_lock:
@@ -701,6 +714,9 @@ def _timed_launch(bucket, dispatch):
         if fresh:
             _compiled_buckets.add(bucket)
     tel = telemetry.get()
+    prof = profiler.get()
+    rec = prof.begin(kernel, bucket=bucket, **(meta or {}))
+    prof.cache_event(kernel, fresh)
     t0 = _time.monotonic_ns()
     try:
         out = dispatch()
@@ -710,39 +726,64 @@ def _timed_launch(bucket, dispatch):
         if fresh:
             with _buckets_lock:
                 _compiled_buckets.discard(bucket)
+        prof.finish(rec)
         raise
+    rec["dispatch_ns"] = _time.monotonic_ns() - t0
     if fresh:
         jax.block_until_ready(out)
+        compile_ns = _time.monotonic_ns() - t0
         tel.count("wgl.kernel.compiles")
-        tel.count("wgl.kernel.compile_ns", _time.monotonic_ns() - t0)
+        tel.count("wgl.kernel.compile_ns", compile_ns)
+        rec["compile_ns"] = compile_ns
+    # cost analysis: computed once right after the bucket's compile
+    # (the executable cache is warm), replayed from cache for hits
+    rec.update(prof.bucket_cost(bucket, lower, fresh))
     tel.count("wgl.kernel.launches")
-    return out
+    return prof.attach(out, rec)
 
 
 def _launch(pb: PackedBatch, rows: Sequence[tuple[int, int]], W: int,
             F: int, reach: bool):
     import jax.numpy as jnp
 
+    prof = profiler.get()
     row_seg, st0 = pb.rows(rows)
+    t0 = _time.monotonic_ns()
     args = (jnp.asarray(pb.inv_t), jnp.asarray(pb.ret_t),
             jnp.asarray(pb.trans), jnp.asarray(pb.m),
             jnp.asarray(pb.sufmin), jnp.asarray(row_seg),
             jnp.asarray(st0))
+    h2d_ns = _time.monotonic_ns() - t0
     bucket = (pb.inv_t.shape, pb.trans.shape[2], len(row_seg), W, F,
               pb.M + 4, reach, pb.has_crashed)
     telemetry.count("wgl.kernel.rows", len(row_seg))
-    return _timed_launch(bucket, lambda: _jitted_kernel()(
-        *args, W=W, F=F, max_iters=pb.M + 4, reach=reach,
-        crash_free=not pb.has_crashed))
+    kw = dict(W=W, F=F, max_iters=pb.M + 4, reach=reach,
+              crash_free=not pb.has_crashed)
+    meta = {"h2d_ns": h2d_ns, "rows": len(row_seg), "batch": pb.B,
+            "m": pb.M, "states": pb.S}
+    return _timed_launch(
+        bucket, lambda: _jitted_kernel()(*args, **kw),
+        kernel="wgl-reach" if reach else "wgl",
+        lower=lambda: _jitted_kernel().lower(*args, **kw), meta=meta)
 
 
 def _drain(out, reach: bool):
     """Materializes a launch's outputs (blocking on the device),
     recording the host wait as execute time plus the kernel's
-    while-loop iteration count. Returns result [B] (reach=False) or
-    (out_mask, unknown) arrays (reach=True)."""
+    while-loop iteration count, and closing the launch's profiler
+    record (device-compute wait, D2H readback). Returns result [B]
+    (reach=False) or (out_mask, unknown) arrays (reach=True)."""
     tel = telemetry.get()
+    prof = profiler.get()
+    rec = prof.take(out)
     t0 = _time.monotonic_ns()
+    try:
+        import jax
+
+        jax.block_until_ready(out)
+    except Exception:  # noqa: BLE001 — numpy outs (mocked launches)
+        pass
+    t_ready = _time.monotonic_ns()
     if reach:
         mask, unk, it = out
         res = (np.asarray(mask), np.asarray(unk))
@@ -750,8 +791,14 @@ def _drain(out, reach: bool):
         r, it = out
         res = np.asarray(r)
     n_it = int(it)
-    tel.count("wgl.kernel.execute_ns", _time.monotonic_ns() - t0)
+    t1 = _time.monotonic_ns()
+    tel.count("wgl.kernel.execute_ns", t1 - t0)
     tel.count("wgl.kernel.iterations", n_it)
+    if rec is not None:
+        rec["compute_ns"] = t_ready - t0
+        rec["d2h_ns"] = t1 - t_ready
+        rec["iterations"] = n_it
+        prof.finish(rec)
     return res
 
 

@@ -212,6 +212,21 @@ def _anomaly_html(rel: str, d: Path) -> str:
             + "</ul>")
 
 
+def _profile_html(d: Path, rel: str) -> str:
+    """The per-run kernel-profile section (device launches, cost +
+    cache + wall-split table) from the run's metrics.json, with a link
+    to the Prometheus exposition of the same metrics."""
+    from . import telemetry as jtel
+    from .reports import profile as rprofile
+
+    metrics = jtel.read_metrics(d / jtel.METRICS_FILE)
+    section = rprofile.profile_html(metrics)
+    if not section:
+        return ""
+    return (section + f"<p><a href='/metrics?run={_html.escape(rel)}'>"
+            "prometheus metrics</a></p>")
+
+
 def dir_html(rel: str, d: Path) -> str:
     entries = sorted(d.iterdir(),
                      key=lambda p: (not p.is_dir(), p.name))
@@ -221,6 +236,7 @@ def dir_html(rel: str, d: Path) -> str:
         f"{'/' if e.is_dir() else ''}</a></li>" for e in entries)
     views = ""
     anomalies = ""
+    profile = ""
     if (d / "test.json").exists():
         # a run directory: link its rendered views next to the raw files
         run_rel = _html.escape(rel.rstrip("/"))
@@ -228,8 +244,15 @@ def dir_html(rel: str, d: Path) -> str:
                  f"</a> · <a href='/live/{run_rel}'>live</a> · "
                  f"<a href='/trace/{run_rel}'>perfetto json</a></p>")
         anomalies = _anomaly_html(rel.rstrip("/"), d)
-    return (f"<!DOCTYPE html><html><body><h1>{_html.escape(rel)}</h1>"
-            f"{views}{anomalies}<ul>{items}</ul></body></html>")
+        profile = _profile_html(d, rel.rstrip("/"))
+    return (f"<!DOCTYPE html><html><head><style>"
+            "table { border-collapse: collapse } "
+            "td, th { padding: 3px 10px; text-align: left; "
+            "border-bottom: 1px solid #eee; font-size: 13px }"
+            "</style></head><body>"
+            f"<h1>{_html.escape(rel)}</h1>"
+            f"{views}{anomalies}{profile}<ul>{items}</ul>"
+            "</body></html>")
 
 
 def live_html(rel: str) -> str:
@@ -471,6 +494,30 @@ class StoreHandler(BaseHTTPRequestHandler):
                         optrace=optrace, ops=ops)
                     self._send(200, json.dumps(doc).encode(),
                                "application/json")
+            elif path == "/metrics":
+                # Prometheus text exposition of a run's metrics.json
+                # (?run=<rel>; default: the current/latest run) — the
+                # scrape endpoint the fleet service (ROADMAP item 2)
+                # will aggregate
+                rel = (query.get("run") or [""])[0].rstrip("/")
+                d = self._live_dir(rel)
+                if d is None:
+                    self._send(404, b"no such run", "text/plain")
+                else:
+                    from . import telemetry as jtel
+                    from .reports import profile as rprofile
+
+                    metrics = jtel.read_metrics(d / jtel.METRICS_FILE)
+                    if metrics is None:
+                        self._send(404, b"no metrics recorded",
+                                   "text/plain")
+                    else:
+                        body = rprofile.prometheus_text(
+                            metrics, run=rel or d.name)
+                        self._send(
+                            200, body.encode(),
+                            "text/plain; version=0.0.4; "
+                            "charset=utf-8")
             elif path.startswith("/zip/"):
                 rel = path[len("/zip/"):].rstrip("/")
                 p = self._resolve(rel)
