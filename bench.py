@@ -225,6 +225,46 @@ def bench_anomaly(n_events):
     }
 
 
+def bench_coverage_overhead(n_events=200_000):
+    """Per-run coverage-record tax (jepsen_tpu.coverage): building +
+    validating the fault × workload × anomaly record over a synthetic
+    headline-scale history, vs the headline's ~60s/1M-event check
+    budget. The record is one history pass (schedule features + the
+    offline fault fold) plus a result walk — vs_baseline reports the
+    fraction of the headline budget it costs (≈0 = free)."""
+    from jepsen_tpu import coverage
+    from jepsen_tpu.tpu import synth
+
+    hist = synth.register_history(n_events // 2, n_procs=5, seed=42)
+    test = {"name": "bench-coverage", "concurrency": 5,
+            "spec": {"workload": "register", "opts": {}},
+            "history": hist,
+            "results": {"valid?": True,
+                        "workload": {"valid?": True,
+                                     "anomaly-classes": {
+                                         "nonlinearizable": "clean"}}}}
+    times = []
+    for _ in range(3):
+        t0 = time.time()
+        rec = coverage.build_record(test,
+                                    recorder=coverage.Recorder())
+        coverage.validate_record(rec)
+        coverage.atlas_entry(rec)
+        times.append(time.time() - t0)
+    elapsed = statistics.median(times)
+    budget_s = 60.0 * (len(hist) / 1_000_000)
+    _log(f"coverage-overhead: record over {len(hist)} events in "
+         f"{elapsed:.3f}s ({elapsed / budget_s:.4f}x of the headline "
+         "budget)")
+    return {
+        "metric": "coverage-record build+validate over a "
+                  f"{len(hist) // 1000}k-event history",
+        "value": round(len(hist) / max(elapsed, 1e-9), 1),
+        "unit": "events/s",
+        "vs_baseline": round(elapsed / budget_s, 4),
+    }
+
+
 def bench_headline(n_events):
     """Config 2: 1M-event register history, segmented device check.
     Median of 5 timed reps (the headline is the line the driver's
@@ -841,6 +881,8 @@ def main():
     if not os.environ.get("BENCH_SKIP_EXTRAS"):
         for fn, args in ((bench_monitor_overhead, ()),
                          (bench_trace_overhead, ()),
+                         (bench_coverage_overhead,
+                          (50_000 if small else 200_000,)),
                          (bench_watchdog_latency, ()),
                          (bench_fallback_overhead,
                           (32 if small else 64,)),

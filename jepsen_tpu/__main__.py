@@ -11,6 +11,40 @@ from __future__ import annotations
 from . import checker as chk
 from . import cli, testing, workloads
 from . import generator as gen
+from . import nemesis as jnemesis
+
+# --nemesis packages for clusterless demo runs: the faults fire
+# against the dummy control plane (commands logged, nothing disturbed,
+# activations recorded), so every run honestly exercises its
+# fault × workload × anomaly coverage cells — including the explicit
+# "fault fired, anomaly checked, none found" negatives the atlas
+# needs (jepsen_tpu.coverage; doc/observability.md).
+NEMESES = {
+    "none": None,
+    "partition": jnemesis.partition_random_halves,
+    "partition-node": jnemesis.partition_random_node,
+    "partition-ring": jnemesis.partition_majorities_ring,
+    "hammer": lambda: jnemesis.hammer_time("demo-daemon"),
+}
+
+
+def _demo_responder(node, action):
+    """Canned command output for clusterless nemesis runs: the
+    partitioner resolves node IPs (getent) and discovers the primary
+    device (ip link) before issuing its iptables commands — answer
+    both so faults fire against the dummy control plane instead of
+    crashing the nemesis process."""
+    cmd = action.cmd
+    if cmd.startswith("getent ahostsv4"):
+        host = cmd.split()[-1]
+        digits = "".join(ch for ch in str(host) if ch.isdigit())
+        n = int(digits) % 250 + 1 if digits else \
+            sum(str(host).encode()) % 250 + 1
+        return f"10.0.0.{n}   STREAM {host}"
+    if cmd == "ip -o link show":
+        return ("1: lo: <LOOPBACK,UP> mtu 65536\n"
+                "2: eth0: <BROADCAST,MULTICAST,UP> mtu 1500")
+    return None
 
 # workload name -> in-memory client factory (testing.py fixtures)
 CLIENTS = {
@@ -124,6 +158,17 @@ def make_test(opts: dict) -> dict:
         # xprof/TensorBoard) of the analysis phase into the run's
         # store dir (doc/observability.md)
         test["xla-trace?"] = True
+    nem_name = opts.get("nemesis") or "none"
+    if nem_name not in NEMESES:
+        raise SystemExit(f"unknown nemesis {nem_name!r}; "
+                         + cli.one_of(NEMESES))
+    if nem_name != "none":
+        test["nemesis"] = NEMESES[nem_name]()
+        if (opts.get("ssh") or {}).get("dummy") and not test.get(
+                "remote"):
+            from .control.dummy import DummyRemote
+
+            test["remote"] = DummyRemote(_demo_responder)
     for k, v in w.items():
         if k not in ("generator", "checker", "final_generator"):
             test[k] = v
@@ -154,10 +199,20 @@ def _spec_opts(opts: dict) -> dict:
 
 
 def _generator(opts: dict, w: dict):
-    main = gen.clients(
-        gen.time_limit(opts.get("time_limit", 60),
-                       gen.stagger(1.0 / opts.get("rate", 100),
-                                   w["generator"])))
+    client_gen = gen.stagger(1.0 / opts.get("rate", 100),
+                             w["generator"])
+    nem_name = opts.get("nemesis") or "none"
+    if nem_name != "none":
+        # the canonical sleep/start/sleep/stop cycle on the nemesis
+        # thread, bounded by the same time limit as the clients
+        main = gen.time_limit(
+            opts.get("time_limit", 60),
+            gen.clients(client_gen,
+                        jnemesis.start_stop_cycle(
+                            opts.get("nemesis_interval", 5.0))))
+    else:
+        main = gen.clients(
+            gen.time_limit(opts.get("time_limit", 60), client_gen))
     final = w.get("final_generator")
     if final is None:
         return main
@@ -193,6 +248,12 @@ def _workload_opt(p):
                    help="Drop an XLA profiler trace of the analysis "
                         "phase into the run's store dir "
                         "(<run>/xla-trace, xprof/TensorBoard format).")
+    p.add_argument("--nemesis", default="none",
+                   help="Fault package to run against the workload "
+                        "(coverage atlas column). " + cli.one_of(
+                            NEMESES))
+    p.add_argument("--nemesis-interval", type=float, default=5.0,
+                   help="Seconds between nemesis start/stop phases.")
     return p
 
 
@@ -207,6 +268,7 @@ def main(argv=None) -> None:
     commands.update(cli.profile_cmd())
     commands.update(cli.trace_cmd())
     commands.update(cli.analyze_cmd(make_test))
+    commands.update(cli.coverage_cmd(list(workloads.REGISTRY)))
     cli.run_cli(commands, argv)
 
 

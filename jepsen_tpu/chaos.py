@@ -40,7 +40,7 @@ from collections import Counter
 from typing import Optional
 
 from . import client as jclient
-from . import telemetry, util
+from . import coverage, telemetry, util
 from .nemesis import core as _jnemesis_core
 from .control.core import Action, Remote, Result, Session, TransportError
 from .history import History
@@ -83,6 +83,10 @@ class _Injector:
             if r < acc:
                 self.tally[kind] += 1
                 telemetry.count(f"chaos.{kind}")
+                # harness faults are coverage cells too (`harness-*`
+                # kinds): a run abused by the chaos rig exercises the
+                # pipeline's crash-safety column in the atlas
+                coverage.record_harness(kind)
                 return kind
         return None
 
@@ -234,11 +238,15 @@ class CrashingNemesis(_jnemesis_core.Nemesis):
     def teardown(self, test):
         if self.crash_teardown:
             telemetry.count("chaos.nemesis-teardown-crashes")
+            coverage.record_harness("nemesis-teardown-crash")
             raise ChaosError("chaos: nemesis teardown crashed")
         self.inner.teardown(test)
 
     def fs(self):
         return self.inner.fs()
+
+    def fault_kinds(self):
+        return self.inner.fault_kinds()
 
 
 # ---------------------------------------------------------------------------

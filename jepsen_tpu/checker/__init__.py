@@ -124,6 +124,28 @@ def merge_valid(valids) -> Any:
     return out
 
 
+def anomaly_classes(result: dict, **classes) -> dict:
+    """Attaches the coverage taxonomy tag to a checker result:
+    `anomaly-classes` maps each class this checker CHECKED to
+    'witnessed' (found), 'clean' (checked, none found — the explicit
+    negative result the coverage atlas needs), or 'unknown' (the check
+    was indeterminate). Values may be bools (witnessed?) — they are
+    resolved against the result's valid? — or literal outcome strings
+    (jepsen_tpu.coverage)."""
+    resolved = {}
+    indeterminate = result.get("valid?") == "unknown"
+    for cls, v in classes.items():
+        cls = cls.replace("_", "-")
+        if isinstance(v, str):
+            resolved[cls] = v
+        elif v:
+            resolved[cls] = "witnessed"
+        else:
+            resolved[cls] = "unknown" if indeterminate else "clean"
+    result["anomaly-classes"] = resolved
+    return result
+
+
 class _Fn(Checker):
     def __init__(self, fn):
         self.fn = fn
@@ -327,6 +349,10 @@ class Linearizable(Checker):
         The filename carries a content fingerprint so concurrent
         per-key checks sharing one store dir never clobber or
         mis-attribute each other's renders."""
+        # coverage taxonomy: the one class this checker decides, with
+        # the explicit negative ("checked, linearizable") recorded
+        anomaly_classes(out,
+                        nonlinearizable=out.get("valid?") is False)
         store_dir = isinstance(test, dict) and test.get("store_dir")
         if store_dir and out.get("valid?") is False:
             try:
@@ -405,13 +431,15 @@ def set_checker() -> Checker:
             if o.f == "read" and o.type == "ok":
                 final_read = o.value
         if final_read is None:
-            return {"valid?": "unknown", "error": "Set was never read"}
+            return anomaly_classes(
+                {"valid?": "unknown", "error": "Set was never read"},
+                set_lost=False, set_unexpected=False)
         final = set(final_read)
         ok = final & attempts
         unexpected = final - attempts
         lost = adds - final
         recovered = ok - adds
-        return {
+        return anomaly_classes({
             "valid?": not lost and not unexpected,
             "attempt-count": len(attempts),
             "acknowledged-count": len(adds),
@@ -427,7 +455,7 @@ def set_checker() -> Checker:
             if _all_ints(unexpected) else sorted(unexpected, key=str),
             "recovered": util.integer_interval_set_str(recovered)
             if _all_ints(recovered) else sorted(recovered, key=str),
-        }
+        }, set_lost=bool(lost), set_unexpected=bool(unexpected))
 
     return _Fn(run)
 
@@ -751,7 +779,9 @@ def set_full(checker_opts: dict | None = None) -> Checker:
                 points, stable_lat)
         if lost_lat:
             out["lost-latencies"] = _frequency_distribution(points, lost_lat)
-        return out
+        return anomaly_classes(out, set_lost=bool(lost_n),
+                               set_stale=bool(stale),
+                               set_duplicated=bool(dups))
 
     return _Fn(run)
 
@@ -806,7 +836,12 @@ def total_queue() -> Checker:
             valid = "unknown" if aborted_drains else False
         else:
             valid = True
-        return {
+        # a "lost" count under an aborted drain is indeterminate, not
+        # a witness — the messages may still sit in the queue
+        lost_outcome = ("clean" if not lost
+                        else "unknown" if aborted_drains
+                        else "witnessed")
+        return anomaly_classes({
             "valid?": valid,
             "aborted-drain-count": aborted_drains,
             "attempt-count": sum(attempts.values()),
@@ -820,7 +855,9 @@ def total_queue() -> Checker:
             "unexpected": dict(unexpected),
             "duplicated": dict(duplicated),
             "recovered": dict(recovered),
-        }
+        }, queue_lost=lost_outcome,
+           queue_unexpected=bool(unexpected),
+           queue_duplicated=bool(duplicated))
 
     return _Fn(run)
 
@@ -836,7 +873,7 @@ def unique_ids() -> Checker:
         freqs = Counter(acks)
         dups = {k: n for k, n in freqs.items() if n > 1}
         rng = [min(acks), max(acks)] if acks else None
-        return {
+        return anomaly_classes({
             "valid?": not dups,
             "attempted-count": attempted,
             "acknowledged-count": len(acks),
@@ -844,7 +881,7 @@ def unique_ids() -> Checker:
             "duplicated": dict(sorted(dups.items(),
                                       key=lambda kv: -kv[1])[:48]),
             "range": rng,
-        }
+        }, duplicate_ids=bool(dups))
 
     return _Fn(run)
 
@@ -876,7 +913,9 @@ def counter() -> Checker:
             elif key == ("ok", "add"):
                 lower += op.value
         errors = [r for r in reads if not (r[0] <= r[1] <= r[2])]
-        return {"valid?": not errors, "reads": reads, "errors": errors}
+        return anomaly_classes(
+            {"valid?": not errors, "reads": reads, "errors": errors},
+            counter_bounds=bool(errors))
 
     return _Fn(run)
 

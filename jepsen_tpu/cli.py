@@ -428,6 +428,51 @@ def analyze_cmd(test_fn=None) -> dict:
     return {"analyze": {"parser_fn": build, "run": run}}
 
 
+def coverage_cmd(all_workloads=None) -> dict:
+    """A 'coverage' subcommand: the cross-run fault × workload ×
+    anomaly matrix, witnessed-cell detail, gap report, and (--suggest)
+    ranked gap-filling configs — the campaign runner's input hook
+    (jepsen_tpu.coverage, doc/observability.md). Scans the store for
+    per-run coverage.json records, folds any missing ones into
+    store/coverage_atlas.jsonl, then aggregates."""
+    def build(p):
+        p.add_argument("--store", default=None,
+                       help="Store base directory (default ./store).")
+        p.add_argument("--suggest", type=int, nargs="?", const=5,
+                       default=0, metavar="N",
+                       help="Also print the top N gap-filling "
+                            "(workload, nemesis) configs (default 5).")
+        p.add_argument("--no-sync", action="store_true",
+                       help="Skip folding stored coverage.json "
+                            "records into the atlas first.")
+        return p
+
+    def run(options):
+        from pathlib import Path
+
+        from . import coverage as jcoverage
+        from . import store as jstore
+
+        base = Path(options.store) if options.store else jstore.BASE
+        if not options.no_sync:
+            n = jcoverage.sync_store(base)
+            if n:
+                print(f"(folded {n} run record(s) into the atlas)")
+        entries = jcoverage.read_atlas(base / jcoverage.ATLAS_FILE)
+        jcoverage.validate_atlas(entries)
+        cells = jcoverage.aggregate(entries)
+        wls = all_workloads
+        if wls is None:
+            from . import workloads
+
+            wls = list(workloads.REGISTRY)
+        print(jcoverage.coverage_text(cells, wls,
+                                      n_suggest=options.suggest))
+        return 0
+
+    return {"coverage": {"parser_fn": build, "run": run}}
+
+
 def serve_cmd() -> dict:
     """A 'serve' subcommand for the web UI (cli.clj:336-354)."""
     def build(p):

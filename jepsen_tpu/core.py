@@ -23,6 +23,7 @@ from typing import Any
 
 from . import client as jclient
 from . import control
+from . import coverage as jcoverage
 from . import db as jdb
 from . import interpreter
 from . import monitor as jmonitor
@@ -426,6 +427,9 @@ def run(test: dict) -> dict:
         # times); nothing in analysis reads the ambient origin itself.
         with util.with_relative_time():
             telemetry.reset()
+            # fault-activation coverage is scoped per run like the
+            # telemetry it rides next to (jepsen_tpu.coverage)
+            jcoverage.reset()
             try:
                 # per-launch device-profile records are scoped per run
                 # like the telemetry they mirror into
@@ -494,6 +498,18 @@ def run(test: dict) -> dict:
                 mon.stop()
                 if store_ctx:
                     store_ctx.save_results(test)
+                # the run's coverage record (fault × workload ×
+                # anomaly cells, doc/observability.md) + its atlas
+                # line; best-effort — coverage must never sink a run
+                if store_ctx and test.get("store_dir"):
+                    try:
+                        rec = jcoverage.write_record(test)
+                        if rec is not None:
+                            jcoverage.append_run(
+                                store_ctx.base_dir(test), rec)
+                    except Exception:  # noqa: BLE001
+                        logger.exception("writing coverage record "
+                                         "failed")
             finally:
                 try:
                     mon.stop()

@@ -84,6 +84,15 @@ class DbNemesis(n.Nemesis):
     def fs(self):
         return {"start", "kill", "pause", "resume"}
 
+    def fault_kinds(self):
+        # here 'start' HEALS a kill window (the db restarts), unlike
+        # the partitioner's 'start' — exactly why kinds are declared
+        # per nemesis rather than guessed from f names
+        return {"kill": ("db-kill", "begin"),
+                "start": ("db-kill", "end"),
+                "pause": ("db-pause", "begin"),
+                "resume": ("db-pause", "end")}
+
 
 def db_generators(opts: dict) -> dict:
     """kill/pause flip-flop generators for a DB (combined.clj:105-146)."""
@@ -199,6 +208,10 @@ class PartitionNemesis(n.Nemesis):
     def fs(self):
         return {"start-partition", "stop-partition"}
 
+    def fault_kinds(self):
+        return {"start-partition": ("partition", "begin"),
+                "stop-partition": ("partition", "end")}
+
 
 def partition_package(opts: dict) -> dict:
     """Network partition package (combined.clj:229-249)."""
@@ -253,6 +266,10 @@ class PacketNemesis(n.Nemesis):
 
     def fs(self):
         return {"start-packet", "stop-packet"}
+
+    def fault_kinds(self):
+        return {"start-packet": ("packet", "begin"),
+                "stop-packet": ("packet", "end")}
 
 
 def packet_package(opts: dict) -> dict:
@@ -374,6 +391,14 @@ class FileCorruptionNemesis(n.Nemesis):
         if self.lazyfs_map is not None:
             fs.add("lose-unfsynced-writes")
         return fs
+
+    def fault_kinds(self):
+        kinds = {"bitflip": ("file-bitflip", "pulse"),
+                 "truncate": ("file-truncate", "pulse")}
+        if self.lazyfs_map is not None:
+            kinds["lose-unfsynced-writes"] = ("file-lost-writes",
+                                              "pulse")
+        return kinds
 
 
 def file_corruption_package(opts: dict) -> dict:

@@ -33,6 +33,18 @@ class Nemesis:
         protocol, nemesis.clj:17-22)."""
         return set()
 
+    def fault_kinds(self) -> dict:
+        """{f: (fault-kind, phase)} — the structured coverage taxonomy
+        tag for each op :f this nemesis speaks. phase is 'begin'/'end'
+        (window-bounding ops like start-partition/stop-partition) or
+        'pulse' (point faults like bitflip). The default derives from
+        fs() via the shared registry (jepsen_tpu.coverage.F_KINDS), so
+        nemeses speaking the standard fs are covered automatically;
+        override to declare custom kinds."""
+        from .. import coverage
+
+        return coverage.default_kinds(self.fs())
+
 
 class NoopNemesis(Nemesis):
     """Does nothing."""
@@ -66,7 +78,7 @@ class Validate(Nemesis):
         return Validate(res)
 
     def invoke(self, test, op):
-        with telemetry.span(f"nemesis:{op.f}"):
+        with telemetry.span(f"nemesis:{op.f}") as span_rec:
             op2 = self.nemesis.invoke(test, op)
         if not isinstance(op2, Op):
             raise InvalidNemesisCompletion(
@@ -74,6 +86,23 @@ class Validate(Nemesis):
         if op2.process != op.process:
             raise InvalidNemesisCompletion(
                 f"process changed: {op!r} -> {op2!r}")
+        # coverage taxonomy: every fault activation that completed is
+        # recorded with its nemesis-declared kind + the span's window
+        # (jepsen_tpu.coverage; fs without a kind — observational ops
+        # like check-offsets — are not faults and stay unrecorded)
+        try:
+            got = self.nemesis.fault_kinds().get(op.f)
+            if got is not None and span_rec is not None:
+                from .. import coverage
+
+                kind, phase = got
+                coverage.record_fault(kind, op.f, phase,
+                                      span_rec["t0"], span_rec["t1"])
+        except Exception:  # noqa: BLE001 — coverage is best-effort
+            import logging
+
+            logging.getLogger(__name__).exception(
+                "recording fault coverage failed")
         return op2
 
     def teardown(self, test):
@@ -82,6 +111,9 @@ class Validate(Nemesis):
 
     def fs(self):
         return self.nemesis.fs()
+
+    def fault_kinds(self):
+        return self.nemesis.fault_kinds()
 
 
 def validate(nemesis: Nemesis) -> Validate:
@@ -149,6 +181,20 @@ class Compose(Nemesis):
                 out |= set(fspec)
         return out
 
+    def fault_kinds(self):
+        out = {}
+        for fspec, nem in self.pairs:
+            inner = nem.fault_kinds()
+            if isinstance(fspec, dict):
+                for outer_f, inner_f in fspec.items():
+                    if inner_f in inner:
+                        out[outer_f] = inner[inner_f]
+            else:
+                for f in fspec:
+                    if f in inner:
+                        out[f] = inner[f]
+        return out
+
 
 def compose(nemeses) -> Nemesis:
     """Takes (fspec, nemesis) pairs — fspec a set of fs or a dict
@@ -190,6 +236,11 @@ class FMap(Nemesis):
     def fs(self):
         inv = self.inv
         return {inv.get(f, f) for f in self.nemesis.fs()}
+
+    def fault_kinds(self):
+        inv = self.inv
+        return {inv.get(f, f): kind
+                for f, kind in self.nemesis.fault_kinds().items()}
 
 
 def f_map(fmap: dict, nemesis: Nemesis) -> FMap:
@@ -301,6 +352,10 @@ class Partitioner(Nemesis):
     def fs(self):
         return {"start", "stop"}
 
+    def fault_kinds(self):
+        return {"start": ("partition", "begin"),
+                "stop": ("partition", "end")}
+
 
 def partitioner(grudge_fn) -> Partitioner:
     return Partitioner(grudge_fn)
@@ -313,12 +368,16 @@ def partitioner(grudge_fn) -> Partitioner:
 class NodeStartStopper(Nemesis):
     """Responds to start/stop by running start_fn/stop_fn on targeted
     nodes with an ambient control session (nemesis.clj:453-496).
-    targeter: (test, nodes) -> node(s) or None to skip."""
+    targeter: (test, nodes) -> node(s) or None to skip. `kind` names
+    the coverage fault kind the start/stop window injects (default
+    'process-pause', the hammer_time use)."""
 
-    def __init__(self, targeter, start_fn, stop_fn):
+    def __init__(self, targeter, start_fn, stop_fn,
+                 kind: str = "process-pause"):
         self.targeter = targeter
         self.start_fn = start_fn
         self.stop_fn = stop_fn
+        self.kind = kind
         self._nodes = None
         self._lock = threading.Lock()
 
@@ -352,9 +411,14 @@ class NodeStartStopper(Nemesis):
     def fs(self):
         return {"start", "stop"}
 
+    def fault_kinds(self):
+        return {"start": (self.kind, "begin"),
+                "stop": (self.kind, "end")}
 
-def node_start_stopper(targeter, start_fn, stop_fn) -> NodeStartStopper:
-    return NodeStartStopper(targeter, start_fn, stop_fn)
+
+def node_start_stopper(targeter, start_fn, stop_fn,
+                       kind: str = "process-pause") -> NodeStartStopper:
+    return NodeStartStopper(targeter, start_fn, stop_fn, kind=kind)
 
 
 def _rand_node_targeter(test, nodes):
@@ -418,6 +482,9 @@ class TruncateFile(Nemesis):
     def fs(self):
         return {"truncate"}
 
+    def fault_kinds(self):
+        return {"truncate": ("file-truncate", "pulse")}
+
 
 def truncate_file() -> TruncateFile:
     return TruncateFile()
@@ -464,6 +531,9 @@ class Bitflip(Nemesis):
 
     def fs(self):
         return {"bitflip"}
+
+    def fault_kinds(self):
+        return {"bitflip": ("file-bitflip", "pulse")}
 
 
 def bitflip() -> Bitflip:

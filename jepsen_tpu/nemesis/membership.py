@@ -141,6 +141,11 @@ class MembershipNemesis(n.Nemesis):
     def fs(self):
         return set(self.state.fs())
 
+    def fault_kinds(self):
+        # every membership transition is a pulse of the one kind: the
+        # coverage cell asks "was membership churned", not which verb
+        return {f: ("membership", "pulse") for f in self.state.fs()}
+
 
 def _freeze_op(op) -> tuple:
     if isinstance(op, dict):

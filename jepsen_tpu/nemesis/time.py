@@ -168,6 +168,13 @@ class ClockNemesis(Nemesis):
     def fs(self):
         return {"reset", "strobe", "bump", "check-offsets"}
 
+    def fault_kinds(self):
+        # check-offsets is observational, not a fault: no kind, so the
+        # coverage layer never records it as an injected disruption
+        return {"bump": ("clock-bump", "pulse"),
+                "strobe": ("clock-strobe", "pulse"),
+                "reset": ("clock-reset", "pulse")}
+
 
 def clock_nemesis() -> ClockNemesis:
     return ClockNemesis()

@@ -191,5 +191,33 @@ def analyze_run(d, resume: bool = False, test_fn=None,
     # `current` symlink (owned by whichever run is live right now) and
     # clobber the run's original test.json with the rebuilt map
     jstore.save_results_only(test)
+    _refresh_coverage(d, test)
     core.log_results(test)
     return test
+
+
+def _refresh_coverage(d: Path, test: dict) -> None:
+    """Regenerates the run's coverage.json after offline re-analysis
+    and re-appends its atlas entry. The live run's fault activations
+    (recorded by the nemesis Validate wrapper with nemesis-declared
+    kinds) are carried over when present — the offline fallback only
+    knows the generic f→kind registry — so an unchanged run re-appends
+    an identical digest and the atlas merge is a no-op: cell counts
+    cannot double under --resume."""
+    from . import coverage as jcoverage
+
+    try:
+        prev = jcoverage.load_record(d)
+        # a fresh recorder: offline analysis has no live nemesis, so
+        # faults derive from the history (or carry over from `prev`) —
+        # never from whatever run the process-global recorder last saw
+        rec = jcoverage.build_record(test,
+                                     recorder=jcoverage.Recorder())
+        if prev and prev.get("faults"):
+            rec["faults"] = prev["faults"]
+        jcoverage.validate_record(rec)
+        with open(d / jcoverage.RECORD_FILE, "w") as f:
+            json.dump(rec, f, indent=1)
+        jcoverage.append_run(d.parent.parent, rec)
+    except Exception:  # noqa: BLE001 — coverage must not sink analyze
+        logger.exception("refreshing coverage record failed")

@@ -22,9 +22,13 @@ Layout:
     optrace.jsonl  per-op causal trace: client/remote child spans +
                    events (jepsen_tpu.tracing, when test["trace?"])
     trace.json     Chrome-trace/Perfetto export (reports/trace.py, on demand)
+    coverage.json  per-run fault × workload × anomaly coverage record
+                   (jepsen_tpu.coverage, doc/observability.md)
     <node>/...     downloaded node logs (core.snarf_logs)
   store/<name>/latest  -> most recent run   store/latest -> same
   store/current        -> run in progress
+  store/coverage_atlas.jsonl  cross-run coverage journal (one line per
+                   analyzed run, newest-per-run wins; jepsen_tpu.coverage)
 """
 
 from __future__ import annotations

@@ -1,2 +1,38 @@
 """DB test suites: consumers of the framework that install and drive
-real databases (the reference ships ~26 of these; see SURVEY.md 2.6)."""
+real databases (the reference ships ~26 of these; see SURVEY.md 2.6).
+
+SUITES maps suite name -> module path; `load(name)` imports lazily (a
+suite pulls in its client transport only when actually driven). Each
+module exposes `main(argv)` — `python -m jepsen_tpu.suites.<name>
+test ...` — plus a `<name>_test(opts)` builder. The registry is what
+the coverage atlas and the campaign runner (ROADMAP item 5) enumerate
+when naming gap-filling suite configs."""
+
+from importlib import import_module
+
+SUITES = {
+    "cockroach": "jepsen_tpu.suites.cockroach",
+    "consul": "jepsen_tpu.suites.consul",
+    "dgraph": "jepsen_tpu.suites.dgraph",
+    "disque": "jepsen_tpu.suites.disque",
+    "elasticsearch": "jepsen_tpu.suites.elasticsearch",
+    "etcd": "jepsen_tpu.suites.etcd",
+    "galera": "jepsen_tpu.suites.galera",
+    "hazelcast": "jepsen_tpu.suites.hazelcast",
+    "mongodb": "jepsen_tpu.suites.mongodb",
+    "postgres": "jepsen_tpu.suites.postgres",
+    "rabbitmq": "jepsen_tpu.suites.rabbitmq",
+    "raftis": "jepsen_tpu.suites.raftis",
+    "stolon": "jepsen_tpu.suites.stolon",
+    "tidb": "jepsen_tpu.suites.tidb",
+    "yugabyte": "jepsen_tpu.suites.yugabyte",
+    "zookeeper": "jepsen_tpu.suites.zookeeper",
+}
+
+
+def load(name: str):
+    """Imports and returns a suite module by registry name."""
+    if name not in SUITES:
+        raise KeyError(f"unknown suite {name!r}; known: "
+                       + ", ".join(sorted(SUITES)))
+    return import_module(SUITES[name])

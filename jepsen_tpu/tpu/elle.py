@@ -423,6 +423,34 @@ def _classify(cycle) -> str:
 
 _SERIALIZABILITY = {"G0", "G1c", "G-single", "G2-item"}
 
+# The anomaly classes each engine CHECKS — the coverage taxonomy's
+# negative-result declaration: a valid verdict still reports every one
+# of these as explicitly checked-and-clean (jepsen_tpu.coverage).
+CHECKED_APPEND = ("G0", "G1a", "G1b", "G1c", "G-single", "G2-item",
+                  "internal", "unobservable-read", "duplicate-appends",
+                  "incompatible-order")
+CHECKED_WR = ("G0", "G1a", "G1b", "G1c", "G-single", "G2-item",
+              "internal", "unobservable-read", "duplicate-writes")
+
+
+def _with_classes(result: dict, checked) -> dict:
+    """Attaches `anomaly-classes` — one outcome per checked class —
+    to an elle check result. A -realtime/-process suffixed cycle
+    witnesses its base class (it is a stronger-model violation of the
+    same Adya phenomenon)."""
+    found = set()
+    for name in (result.get("anomalies") or {}):
+        base = name
+        for suffix in ("-realtime", "-process"):
+            if base.endswith(suffix):
+                base = base[:-len(suffix)]
+        found.add(base)
+        found.add(name)
+    result["anomaly-classes"] = {
+        cls: ("witnessed" if cls in found else "clean")
+        for cls in checked}
+    return result
+
 
 def cycle_anomalies(n: int, edges, txns) -> dict[str, list]:
     """SCC search over increasingly strong edge subsets, so each cycle
@@ -530,8 +558,9 @@ def check_list_append(hist, opts: dict | None = None) -> dict:
                               and len(hist) >= _DEVICE_MIN_OPS):
         from . import elle_device
         try:
-            return annotate_op_indices(
-                elle_device.check_list_append_device(hist), hist)
+            return _with_classes(annotate_op_indices(
+                elle_device.check_list_append_device(hist), hist),
+                CHECKED_APPEND)
         except elle_device.Unvectorizable:
             if engine == "device":
                 raise
@@ -552,7 +581,8 @@ def check_list_append(hist, opts: dict | None = None) -> dict:
     }
     if degraded:
         out["degradation"] = degraded
-    return annotate_op_indices(out, hist)
+    return _with_classes(annotate_op_indices(out, hist),
+                         CHECKED_APPEND)
 
 
 def check_rw_register(hist, opts: dict | None = None) -> dict:
@@ -578,8 +608,9 @@ def check_rw_register(hist, opts: dict | None = None) -> dict:
         from . import elle_device
 
         try:
-            return annotate_op_indices(
-                elle_device.check_rw_register_device(hist), hist)
+            return _with_classes(annotate_op_indices(
+                elle_device.check_rw_register_device(hist), hist),
+                CHECKED_WR)
         except elle_device.Unvectorizable:
             pass  # host edge inference below; SCC still on device
         except Exception as e:  # noqa: BLE001 — device ladder
@@ -705,5 +736,5 @@ def check_rw_register(hist, opts: dict | None = None) -> dict:
     }
     if degraded:
         out["degradation"] = degraded
-    return annotate_op_indices(out, hist)
+    return _with_classes(annotate_op_indices(out, hist), CHECKED_WR)
 

@@ -81,7 +81,19 @@ def _memory_analysis_enabled() -> bool:
 # Per-launch records are mirrored into telemetry individually only up
 # to this many launches per kernel per run; past it, only aggregates
 # accumulate (a 1024-history ensemble must not write 1024 span lines).
+# The cap is configurable (JEPSEN_TPU_PROFILE_MAX_SPANS) and NEVER
+# silent: every launch past it counts `profiler.<k>.spans_dropped` in
+# metrics.json, so a truncated telemetry mirror is visible instead of
+# reading as "that's all the launches there were".
 MAX_MIRRORED_LAUNCHES = 64
+
+
+def max_mirrored_launches() -> int:
+    try:
+        return int(os.environ.get("JEPSEN_TPU_PROFILE_MAX_SPANS",
+                                  MAX_MIRRORED_LAUNCHES))
+    except ValueError:
+        return MAX_MIRRORED_LAUNCHES
 
 
 def _fresh_bucket_cost(lower: Callable, bucket_key) -> dict:
@@ -293,12 +305,16 @@ class Profiler:
         if rec.get("balance") is not None:
             tel.gauge(f"profiler.{k}.balance", rec["balance"])
         n_k = sum(1 for r in self._records if r["kernel"] == k)
-        if n_k <= MAX_MIRRORED_LAUNCHES:
+        if n_k <= max_mirrored_launches():
             attrs = {kk: v for kk, v in rec.items()
                      if kk not in ("kernel", "t0", "t1")
                      and v is not None}
             tel.record_span(f"kernel:{k}", rec["t0"], rec["t1"], attrs,
                             epoch=epoch)
+        else:
+            # no silent caps: truncation of the telemetry mirror is
+            # itself a metric (aggregates above still saw the launch)
+            tel.count(f"profiler.{k}.spans_dropped")
 
     # -- simple sites ------------------------------------------------------
 

@@ -122,6 +122,100 @@ def _valid_color(valid) -> str:
             "unknown": "#FFAA26"}.get(valid, "#eeeeee")
 
 
+# coverage heatmap cell colors: witnessed shares the invalid pink
+# (an anomaly was found), clean the valid blue, indeterminate the
+# unknown orange; never-exercised gaps stay blank
+_STATUS_COLOR = {"witnessed": "#FEB5DA", "clean": "#6DB6FE",
+                 "unknown": "#FFAA26", "gap": "#f4f4f4"}
+
+
+def _atlas_cells(base: Path):
+    from . import coverage as jcoverage
+
+    entries = jcoverage.read_atlas(Path(base) / jcoverage.ATLAS_FILE)
+    return jcoverage.aggregate(entries)
+
+
+def coverage_html(cells, all_workloads=None) -> str:
+    """The /coverage/ heatmap: fault kinds × workloads, each cell
+    colored by its folded status and deep-linking to the cell detail
+    page (witnessing runs + anomaly classes)."""
+    from . import coverage as jcoverage
+
+    faults, wls = jcoverage._axes(cells, all_workloads)
+    head = "".join(
+        f"<th><div>{_html.escape(k)}</div></th>" for k in faults)
+    rows = []
+    for w in wls:
+        tds = []
+        for k in faults:
+            st = jcoverage.cell_status(cells, k, w)
+            runs = sum(c["runs"] for (ck, cw, _a), c in cells.items()
+                       if ck == k and cw == w)
+            label = {"witnessed": "X", "clean": "o",
+                     "unknown": "?", "gap": ""}[st]
+            title = _html.escape(f"{k} × {w}: {st}, {runs} cell-runs")
+            tds.append(
+                f"<td style='background:{_STATUS_COLOR[st]}' "
+                f"title='{title}'>"
+                f"<a href='/coverage/{_html.escape(k)}/"
+                f"{_html.escape(w)}'>{label or '&nbsp;'}</a></td>")
+        rows.append(f"<tr><td class='wl'>{_html.escape(w)}</td>"
+                    + "".join(tds) + "</tr>")
+    return ("<!DOCTYPE html><html><head><meta charset='utf-8'>"
+            "<title>coverage atlas</title><style>"
+            "body { font-family: sans-serif } "
+            "table { border-collapse: collapse } "
+            "td, th { padding: 3px 6px; border: 1px solid #fff; "
+            "font-size: 12px; text-align: center } "
+            "td.wl { text-align: left } "
+            "th div { writing-mode: vertical-rl; "
+            "transform: rotate(180deg); } "
+            "td a { color: inherit; text-decoration: none; "
+            "display: block }"
+            "</style></head><body><h1>coverage atlas</h1>"
+            "<p>fault kind × workload; X = anomaly witnessed, "
+            "o = checked clean, ? = indeterminate, blank = never "
+            "exercised. Cells link to witnessing runs.</p>"
+            "<table><tr><th>workload</th>" + head + "</tr>"
+            + "".join(rows) + "</table>"
+            "<p><a href='/'>home</a></p></body></html>")
+
+
+def coverage_cell_html(cells, fault: str, workload: str) -> str:
+    """One cell's drill-down: per-anomaly-class outcomes with links to
+    the witnessing runs (whose pages carry the anomaly excerpts and
+    pre-filtered trace views)."""
+    rows = []
+    for (k, w, cls), c in sorted(cells.items()):
+        if k != fault or w != workload:
+            continue
+        links = " ".join(
+            f"<a href='/files/{_html.escape(r)}/'>{_html.escape(r)}"
+            "</a>" for r in c["witnesses"][:8])
+        rows.append(
+            "<tr>"
+            f"<td>{_html.escape(cls)}</td><td>{c['runs']}</td>"
+            f"<td>{c['witnessed']}</td><td>{c['clean']}</td>"
+            f"<td>{c['unknown']}</td><td>{links}</td></tr>")
+    body = ("<table><tr><th>anomaly class</th><th>runs</th>"
+            "<th>witnessed</th><th>clean</th><th>unknown</th>"
+            "<th>witnessing runs</th></tr>" + "".join(rows)
+            + "</table>") if rows else \
+        "<p>never exercised — a coverage gap.</p>"
+    return ("<!DOCTYPE html><html><head><meta charset='utf-8'>"
+            f"<title>{_html.escape(fault)} × "
+            f"{_html.escape(workload)}</title><style>"
+            "body { font-family: sans-serif } "
+            "table { border-collapse: collapse } "
+            "td, th { padding: 3px 10px; text-align: left; "
+            "border-bottom: 1px solid #eee; font-size: 13px }"
+            "</style></head><body>"
+            f"<h1>{_html.escape(fault)} × {_html.escape(workload)}"
+            "</h1>" + body
+            + "<p><a href='/coverage/'>atlas</a></p></body></html>")
+
+
 def home_html(base: Path | None = None) -> str:
     rows = []
     for t in fast_tests(base):
@@ -148,7 +242,8 @@ def home_html(base: Path | None = None) -> str:
             "body { font-family: sans-serif } "
             "table { border-collapse: collapse } "
             "td, th { padding: 4px 10px; text-align: left }"
-            "</style></head><body><h1>Jepsen</h1><table>"
+            "</style></head><body><h1>Jepsen</h1>"
+            "<p><a href='/coverage/'>coverage atlas</a></p><table>"
             "<tr><th>Test</th><th>Time</th><th>Valid?</th>"
             "<th colspan=5>Artifacts</th></tr>"
             + "".join(rows) + "</table></body></html>")
@@ -494,6 +589,25 @@ class StoreHandler(BaseHTTPRequestHandler):
                         optrace=optrace, ops=ops)
                     self._send(200, json.dumps(doc).encode(),
                                "application/json")
+            elif path == "/coverage" or path.startswith("/coverage/"):
+                # the cross-run fault × workload × anomaly heatmap
+                # (jepsen_tpu.coverage); /coverage/<fault>/<workload>
+                # drills into one cell's witnessing runs
+                cells = _atlas_cells(self.base)
+                rest = [x for x in
+                        path[len("/coverage"):].split("/") if x]
+                if len(rest) == 2:
+                    self._send(200, coverage_cell_html(
+                        cells, rest[0], rest[1]).encode())
+                else:
+                    try:
+                        from . import workloads
+
+                        wls = list(workloads.REGISTRY)
+                    except ImportError:
+                        wls = None
+                    self._send(200,
+                               coverage_html(cells, wls).encode())
             elif path == "/metrics":
                 # Prometheus text exposition of a run's metrics.json
                 # (?run=<rel>; default: the current/latest run) — the
@@ -514,6 +628,19 @@ class StoreHandler(BaseHTTPRequestHandler):
                     else:
                         body = rprofile.prometheus_text(
                             metrics, run=rel or d.name)
+                        # atlas-level coverage samples ride on the
+                        # same scrape (jepsen_tpu.coverage)
+                        try:
+                            from . import coverage as jcoverage
+
+                            cells = _atlas_cells(self.base)
+                            if cells:
+                                body += "\n".join(
+                                    jcoverage.prometheus_lines(
+                                        cells)) + "\n"
+                        except Exception:  # noqa: BLE001
+                            logger.exception(
+                                "coverage metrics failed")
                         self._send(
                             200, body.encode(),
                             "text/plain; version=0.0.4; "

@@ -129,8 +129,12 @@ def checker(opts: dict | None = None) -> chk.Checker:
                  if isinstance(test, dict) else None)
         if total is None:
             total = o.get("total-amount", 0)
-        return check_fast(hist, total,
-                          negative_ok=o.get("negative-balances?", False))
+        out = check_fast(hist, total,
+                         negative_ok=o.get("negative-balances?",
+                                           False))
+        # coverage taxonomy tag, explicit negative included
+        return chk.anomaly_classes(
+            out, bank_imbalance=bool(out.get("error-count")))
 
     return _Fn(run)
 

@@ -1,0 +1,555 @@
+"""Coverage atlas tests: record schema round-trip, fault folding,
+anomaly-class outcomes (explicit negatives included), atlas merge
+idempotence under re-analysis, gap-report/--suggest determinism, the
+web heatmap, and the two-seeded-runs acceptance path from ISSUE 7."""
+
+import json
+import urllib.request
+
+import pytest
+
+from jepsen_tpu import checker as jchecker
+from jepsen_tpu import core, coverage, testing, web
+from jepsen_tpu import generator as gen
+from jepsen_tpu import nemesis as jnemesis
+from jepsen_tpu import net
+from jepsen_tpu import store as jstore
+from jepsen_tpu.__main__ import _demo_responder
+from jepsen_tpu.control.dummy import DummyRemote
+from jepsen_tpu.history import History, Op, op
+from jepsen_tpu.workloads import sets as sets_wl
+
+
+# ---------------------------------------------------------------------------
+# Fault folding + taxonomy
+# ---------------------------------------------------------------------------
+
+class TestFaultFolding:
+    def test_begin_end_pairs_to_window(self):
+        acts = [
+            {"kind": "partition", "f": "start", "phase": "begin",
+             "t0": 10},
+            {"kind": "partition", "f": "stop", "phase": "end",
+             "t0": 20, "t1": 25},
+            {"kind": "partition", "f": "start", "phase": "begin",
+             "t0": 40},
+        ]
+        out = coverage.fold_faults(acts)
+        assert out == [{"kind": "partition", "count": 2,
+                        "windows": [[10, 25], [40, None]]}]
+
+    def test_pulse_is_degenerate_window(self):
+        out = coverage.fold_faults(
+            [{"kind": "file-bitflip", "f": "bitflip",
+              "phase": "pulse", "t0": 7, "t1": 9}])
+        assert out == [{"kind": "file-bitflip", "count": 1,
+                        "windows": [[7, 9]]}]
+
+    def test_harness_counts_ride_along(self):
+        out = coverage.fold_faults([], {"harness-drop-connection": 3})
+        assert out == [{"kind": "harness-drop-connection", "count": 3,
+                        "windows": []}]
+
+    def test_faults_from_history_fallback(self):
+        """The interpreter journals each nemesis op twice (dispatch
+        invocation + completion, both info on the nemesis process):
+        the fallback must count each activation ONCE, matching the
+        live recorder."""
+        hist = History([
+            op(type="info", process="nemesis", f="start-partition",
+               value=None, time=5),
+            op(type="info", process="nemesis", f="start-partition",
+               value="isolated", time=6),
+            op(type="invoke", process=0, f="read", value=None,
+               time=7),
+            op(type="ok", process=0, f="read", value=1, time=8),
+            op(type="info", process="nemesis", f="stop-partition",
+               value=None, time=9),
+            op(type="info", process="nemesis", f="stop-partition",
+               value="healed", time=10),
+        ])
+        out = coverage.faults_from_history(hist)
+        assert out == [{"kind": "partition", "count": 1,
+                        "windows": [[5, 9]]}]
+
+    def test_fallback_counts_match_live_recording(self, tmp_path):
+        """End-to-end pin of the double-journal hazard: the same run's
+        history-derived fault counts must equal the live recorder's
+        (a crash-recovered run must not report 2x the injections)."""
+        t = core.run(_partitioned_register_test(tmp_path))
+        rec = coverage.load_record(t["store_dir"])
+        live = {f["kind"]: f["count"] for f in rec["faults"]}
+        derived = {f["kind"]: f["count"]
+                   for f in coverage.faults_from_history(t["history"])}
+        assert live == derived
+        assert live.get("partition", 0) >= 1
+        # the schedule signature counts each journaled pair once too
+        n_entries = sum(1 for o in t["history"]
+                        if not isinstance(o.process, int))
+        assert rec["signature"]["nemesis-ops"] * 2 == n_entries
+
+    def test_nemesis_declared_kinds(self):
+        assert jnemesis.partition_random_halves().fault_kinds() == {
+            "start": ("partition", "begin"),
+            "stop": ("partition", "end")}
+        assert jnemesis.hammer_time("x").fault_kinds() == {
+            "start": ("process-pause", "begin"),
+            "stop": ("process-pause", "end")}
+
+    def test_validate_wrapper_records_activation(self):
+        """The nemesis Validate wrapper records every completed fault
+        activation with its nemesis-declared kind + span window."""
+        rec = coverage.Recorder()
+
+        class Boring(jnemesis.Nemesis):
+            def invoke(self, test, o):
+                return o
+
+            def fs(self):
+                return {"start", "stop"}
+
+            def fault_kinds(self):
+                return {"start": ("partition", "begin"),
+                        "stop": ("partition", "end")}
+
+        import unittest.mock as mock
+
+        from jepsen_tpu import util
+
+        util.init_relative_time()
+        v = jnemesis.validate(Boring())
+        with mock.patch.object(coverage, "_global", rec):
+            v.invoke({}, Op(index=0, time=0, type="info",
+                            process="nemesis", f="start", value=None))
+            v.invoke({}, Op(index=1, time=1, type="info",
+                            process="nemesis", f="stop", value=None))
+        faults = coverage.fold_faults(rec.activations())
+        assert len(faults) == 1 and faults[0]["kind"] == "partition"
+        assert faults[0]["count"] == 1
+        assert len(faults[0]["windows"]) == 1
+        t0, t1 = faults[0]["windows"][0]
+        assert t1 is not None and t1 >= t0 >= 0
+
+
+# ---------------------------------------------------------------------------
+# Anomaly outcomes
+# ---------------------------------------------------------------------------
+
+class TestAnomalyOutcomes:
+    def test_explicit_negative_results(self):
+        """A valid verdict still reports every checked class — the
+        'fault fired, anomaly class checked, none found' cell."""
+        results = {"valid?": True,
+                   "workload": {"valid?": True,
+                                "anomaly-classes": {
+                                    "nonlinearizable": "clean"}}}
+        out = coverage.anomaly_outcomes(results)
+        assert out == [{"class": "nonlinearizable",
+                        "checker": "workload",
+                        "outcome": "clean"}]
+
+    def test_witnessed_carries_op_indices(self):
+        results = {"valid?": False,
+                   "workload": {
+                       "valid?": False,
+                       "anomaly-classes": {"G1a": "witnessed",
+                                           "G0": "clean"},
+                       "anomalies": {"G1a": [
+                           {"op-indices": [3, 7]}]}}}
+        out = {a["class"]: a for a in
+               coverage.anomaly_outcomes(results)}
+        assert out["G1a"]["outcome"] == "witnessed"
+        assert out["G1a"]["op-indices"] == [3, 7]
+        assert out["G0"]["outcome"] == "clean"
+
+    def test_witnessed_dominates_across_checkers(self):
+        results = {
+            "a": {"anomaly-classes": {"set-lost": "clean"}},
+            "b": {"anomaly-classes": {"set-lost": "witnessed"}}}
+        out = coverage.anomaly_outcomes(results)
+        assert out[0]["outcome"] == "witnessed"
+
+    def test_watchdog_is_a_checked_class(self):
+        out = coverage.anomaly_outcomes(
+            {"valid?": True, "watchdog": {"count": 2}})
+        assert out == [{"class": "watchdog", "checker": "watchdog",
+                        "outcome": "witnessed"}]
+
+    def test_checker_taggers(self):
+        """The checker-module taxonomy threads: every family attaches
+        anomaly-classes with explicit negatives."""
+        hist = History([
+            op(type="invoke", process=0, f="add", value=1),
+            op(type="ok", process=0, f="add", value=1),
+            op(type="invoke", process=0, f="read", value=None),
+            op(type="ok", process=0, f="read", value=[1]),
+        ])
+        res = jchecker.check(jchecker.set_checker(), {}, hist)
+        assert res["anomaly-classes"] == {"set-lost": "clean",
+                                          "set-unexpected": "clean"}
+        lossy = History([
+            op(type="invoke", process=0, f="add", value=1),
+            op(type="ok", process=0, f="add", value=1),
+            op(type="invoke", process=0, f="read", value=None),
+            op(type="ok", process=0, f="read", value=[]),
+        ])
+        res = jchecker.check(jchecker.set_checker(), {}, lossy)
+        assert res["anomaly-classes"]["set-lost"] == "witnessed"
+
+    def test_elle_checked_classes(self):
+        from jepsen_tpu.tpu import elle
+
+        hist = History([
+            op(type="invoke", process=0, f="txn",
+               value=[["append", "x", 1]]),
+            op(type="ok", process=0, f="txn",
+               value=[["append", "x", 1]]),
+        ])
+        res = elle.check_list_append(hist, {"engine": "host"})
+        classes = res["anomaly-classes"]
+        assert set(classes) == set(elle.CHECKED_APPEND)
+        assert all(v == "clean" for v in classes.values())
+
+
+# ---------------------------------------------------------------------------
+# Record schema
+# ---------------------------------------------------------------------------
+
+def _synthetic_test(tmp_path=None, results=None):
+    hist = History([
+        op(type="info", process="nemesis", f="start-partition",
+           value=None, time=2),
+        op(type="invoke", process=0, f="read", value=None, time=3),
+        op(type="ok", process=0, f="read", value=1, time=4),
+        op(type="info", process="nemesis", f="stop-partition",
+           value=None, time=5),
+    ])
+    t = {"name": "synthetic", "concurrency": 2,
+         "spec": {"workload": "register",
+                  "opts": {"rate": 10, "ops": 4}},
+         "history": hist,
+         "results": results if results is not None else {
+             "valid?": True,
+             "workload": {"valid?": True,
+                          "anomaly-classes": {
+                              "nonlinearizable": "clean"}}}}
+    if tmp_path is not None:
+        d = tmp_path / "store" / "synthetic" / "20260801T000000.0000"
+        d.mkdir(parents=True, exist_ok=True)
+        t["store_dir"] = str(d)
+    return t
+
+
+class TestRecordSchema:
+    def test_round_trip(self, tmp_path):
+        test = _synthetic_test(tmp_path)
+        rec = coverage.write_record(test,
+                                    recorder=coverage.Recorder())
+        assert coverage.validate_record(rec) > 0
+        loaded = coverage.load_record(test["store_dir"])
+        assert coverage.validate_record(loaded) > 0
+        assert loaded == json.loads(json.dumps(rec))
+        assert loaded["workload"] == "register"
+        # the history fallback classified the partition window
+        assert loaded["faults"] == [
+            {"kind": "partition", "count": 1, "windows": [[2, 5]]}]
+        assert loaded["anomalies"][0]["outcome"] == "clean"
+        assert loaded["signature"]["client-ops"] == 1
+
+    def test_live_recorder_wins_over_history(self):
+        rec = coverage.Recorder()
+        rec.record("process-pause", "start", "begin", 1, 2)
+        out = coverage.build_record(_synthetic_test(), recorder=rec)
+        assert [f["kind"] for f in out["faults"]] == ["process-pause"]
+
+    @pytest.mark.parametrize("mutate", [
+        lambda r: r.pop("run"),
+        lambda r: r.__setitem__("schema", 99),
+        lambda r: r.__setitem__("faults", {"not": "a list"}),
+        lambda r: r["faults"].append({"count": 1}),
+        lambda r: r["faults"].append({"kind": "x", "count": -1}),
+        lambda r: r["faults"].append(
+            {"kind": "x", "count": 1, "windows": [[1]]}),
+        lambda r: r["anomalies"].append({"class": "g",
+                                         "outcome": "meh"}),
+        lambda r: r["anomalies"].append(
+            {"class": "g", "outcome": "clean", "op-indices": ["x"]}),
+    ])
+    def test_validate_rejects_bad_records(self, mutate):
+        rec = coverage.build_record(_synthetic_test(),
+                                    recorder=coverage.Recorder())
+        mutate(rec)
+        with pytest.raises(ValueError):
+            coverage.validate_record(rec)
+
+
+# ---------------------------------------------------------------------------
+# Atlas merge semantics
+# ---------------------------------------------------------------------------
+
+class TestAtlas:
+    def test_append_and_aggregate(self, tmp_path):
+        rec = coverage.build_record(_synthetic_test(),
+                                    recorder=coverage.Recorder())
+        coverage.append_run(tmp_path, rec)
+        entries = coverage.read_atlas(tmp_path / coverage.ATLAS_FILE)
+        assert coverage.validate_atlas(entries) == 1
+        cells = coverage.aggregate(entries)
+        assert cells[("partition", "register",
+                      "nonlinearizable")]["runs"] == 1
+
+    def test_reappend_same_digest_is_noop(self, tmp_path):
+        rec = coverage.build_record(_synthetic_test(),
+                                    recorder=coverage.Recorder())
+        coverage.append_run(tmp_path, rec)
+        coverage.append_run(tmp_path, rec)
+        path = tmp_path / coverage.ATLAS_FILE
+        assert len(coverage.read_atlas(path)) == 1
+
+    def test_reanalysis_replaces_not_doubles(self, tmp_path):
+        """The --resume contract: a changed re-analysis of the same
+        run appends a new line, but aggregation counts the run ONCE
+        (newest entry wins)."""
+        test = _synthetic_test()
+        rec1 = coverage.build_record(test,
+                                     recorder=coverage.Recorder())
+        coverage.append_run(tmp_path, rec1)
+        test["results"]["workload"]["anomaly-classes"][
+            "nonlinearizable"] = "witnessed"
+        test["results"]["valid?"] = False
+        rec2 = coverage.build_record(test,
+                                     recorder=coverage.Recorder())
+        coverage.append_run(tmp_path, rec2)
+        entries = coverage.read_atlas(tmp_path / coverage.ATLAS_FILE)
+        assert len(entries) == 2  # journal keeps both lines...
+        cells = coverage.aggregate(entries)
+        cell = cells[("partition", "register", "nonlinearizable")]
+        assert cell["runs"] == 1  # ...but the run counts once
+        assert cell["witnessed"] == 1 and cell["clean"] == 0
+
+    def test_torn_tail_tolerated(self, tmp_path):
+        rec = coverage.build_record(_synthetic_test(),
+                                    recorder=coverage.Recorder())
+        coverage.append_run(tmp_path, rec)
+        path = tmp_path / coverage.ATLAS_FILE
+        with open(path, "a") as f:
+            f.write('{"run": "torn')  # writer died mid-append
+        assert len(coverage.read_atlas(path)) == 1
+
+    def test_no_fault_run_lands_in_none_column(self, tmp_path):
+        test = _synthetic_test()
+        test["history"] = History([
+            op(type="invoke", process=0, f="read", value=None),
+            op(type="ok", process=0, f="read", value=1),
+        ])
+        rec = coverage.build_record(test,
+                                    recorder=coverage.Recorder())
+        cells = coverage.aggregate([coverage.atlas_entry(rec)])
+        assert ("none", "register", "nonlinearizable") in cells
+
+    def test_sync_store_folds_run_dirs(self, tmp_path):
+        test = _synthetic_test(tmp_path)
+        coverage.write_record(test, recorder=coverage.Recorder())
+        base = tmp_path / "store"
+        assert coverage.sync_store(base) == 1
+        assert coverage.sync_store(base) == 0  # second sync: no-op
+        entries = coverage.read_atlas(base / coverage.ATLAS_FILE)
+        assert len(entries) == 1
+
+
+# ---------------------------------------------------------------------------
+# Matrix, gaps, suggestions
+# ---------------------------------------------------------------------------
+
+def _two_run_cells():
+    clean = coverage.atlas_entry({
+        "run": "a/1", "ts": 1.0, "workload": "register",
+        "faults": [{"kind": "partition", "count": 2, "windows": []}],
+        "anomalies": [{"class": "nonlinearizable",
+                       "outcome": "clean"}],
+        "valid": True})
+    witnessed = coverage.atlas_entry({
+        "run": "b/1", "ts": 2.0, "workload": "set",
+        "faults": [],
+        "anomalies": [{"class": "set-lost", "outcome": "witnessed"},
+                      {"class": "set-unexpected",
+                       "outcome": "clean"}],
+        "valid": False})
+    return coverage.aggregate([clean, witnessed])
+
+
+class TestMatrixAndSuggest:
+    def test_matrix_shows_all_three_cell_states(self):
+        cells = _two_run_cells()
+        txt = coverage.matrix_text(cells, ["register", "set", "bank"])
+        assert "X" in txt and "o" in txt and "·" in txt
+        assert "partition" in txt
+
+    def test_gap_report_counts_unexercised_cells(self):
+        cells = _two_run_cells()
+        gs = coverage.gaps(cells, ["register", "set"])
+        assert ("db-kill", "register") in gs
+        assert ("partition", "register") not in gs
+        assert ("none", "set") not in gs
+
+    def test_suggest_deterministic_and_diverse(self):
+        cells = _two_run_cells()
+        s1 = coverage.suggest(cells, ["register", "set", "bank"],
+                              limit=6)
+        s2 = coverage.suggest(cells, ["register", "set", "bank"],
+                              limit=6)
+        assert s1 == s2  # pure function of the atlas: deterministic
+        assert len({s["fault"] for s in s1}) == 6  # diversified
+        assert all(s["config"] for s in s1)
+
+    def test_suggest_names_runnable_config_for_gap(self):
+        cells = _two_run_cells()
+        got = coverage.suggest(cells, ["bank"], limit=50)
+        partition_gap = [s for s in got
+                         if s["fault"] == "partition"
+                         and s["workload"] == "bank"]
+        assert partition_gap and "--nemesis partition" in \
+            partition_gap[0]["config"]
+
+    def test_prometheus_lines_scrape_parse(self):
+        from jepsen_tpu.reports.profile import \
+            validate_prometheus_text
+
+        lines = coverage.prometheus_lines(_two_run_cells())
+        n = validate_prometheus_text("\n".join(lines) + "\n")
+        assert n > 0
+        joined = "\n".join(lines)
+        assert "jepsen_tpu_coverage_runs" in joined
+        assert 'jepsen_tpu_coverage_cells{status="witnessed"} 1' in \
+            joined
+
+
+# ---------------------------------------------------------------------------
+# End-to-end: two seeded runs -> atlas -> CLI + web (+ --resume)
+# ---------------------------------------------------------------------------
+
+def _partitioned_register_test(tmp_path):
+    """A clean register run under a real (dummy-remote) partition
+    nemesis: the canonical negative-result cell."""
+    net.clear_ip_cache()
+    state = testing.AtomState()
+    import random as _random
+
+    rng = _random.Random(11)
+    from jepsen_tpu.workloads import register as register_wl
+
+    t = testing.noop_test()
+    t.update(
+        name="cov-register", store_base=str(tmp_path),
+        nodes=["n1", "n2"], concurrency=4,
+        remote=DummyRemote(_demo_responder),
+        client=testing.AtomClient(state),
+        nemesis=jnemesis.partition_random_halves(),
+        checker=jchecker.compose({
+            "stats": jchecker.stats(),
+            "workload": jchecker.checker(
+                lambda test, hist, opts: jchecker.anomaly_classes(
+                    {"valid?": True}, nonlinearizable=False))}),
+        generator=gen.clients(
+            gen.limit(30, lambda: register_wl.cas_op_mix(
+                rng, n_values=3)),
+            gen.limit(4, gen.cycle(gen.phases(
+                {"type": "info", "f": "start"},
+                {"type": "info", "f": "stop"})))))
+    t["spec"] = {"workload": "register", "opts": {"ops": 30}}
+    return t
+
+
+def _lossy_set_test(tmp_path):
+    """A set run whose client acks-then-drops adds: the witnessed
+    cell, with no nemesis (the `none` baseline column)."""
+    w = sets_wl.workload({"ops": 40})
+    t = testing.noop_test()
+    t.update(
+        name="cov-set", store_base=str(tmp_path),
+        nodes=["n1", "n2"], concurrency=4,
+        client=testing.SetClient(drop_every=5),
+        checker=w["checker"],
+        generator=gen.clients(w["generator"]))
+    t["spec"] = {"workload": "set", "opts": {"ops": 40}}
+    return t
+
+
+class TestEndToEnd:
+    def test_two_runs_build_the_acceptance_matrix(self, tmp_path):
+        t1 = core.run(_partitioned_register_test(tmp_path))
+        t2 = core.run(_lossy_set_test(tmp_path))
+        assert t1["results"]["valid?"] is True
+        assert t2["results"]["valid?"] is False
+
+        # per-run records landed and validate
+        for t in (t1, t2):
+            rec = coverage.load_record(t["store_dir"])
+            assert rec and coverage.validate_record(rec) > 0
+        rec1 = coverage.load_record(t1["store_dir"])
+        assert [f["kind"] for f in rec1["faults"]] == ["partition"]
+        assert rec1["faults"][0]["count"] >= 1
+        assert rec1["faults"][0]["windows"]
+
+        entries = coverage.read_atlas(
+            tmp_path / coverage.ATLAS_FILE)
+        assert coverage.validate_atlas(entries) == 2
+        cells = coverage.aggregate(entries)
+        # the acceptance triple: a witnessed cell, a checked-but-
+        # clean cell, and a never-exercised gap
+        assert cells[("none", "set", "set-lost")]["witnessed"] == 1
+        assert cells[("partition", "register",
+                      "nonlinearizable")]["clean"] == 1
+        assert ("db-kill", "register") in coverage.gaps(
+            cells, ["register", "set"])
+        # --suggest names a config filling a gap
+        sug = coverage.suggest(cells, ["register", "set"], limit=50)
+        assert any(s["fault"] == "db-kill" for s in sug)
+
+        # the CLI renders the same matrix
+        from jepsen_tpu import cli as jcli
+
+        cmd = jcli.coverage_cmd(["register", "set"])["coverage"]
+        import argparse
+
+        p = cmd["parser_fn"](argparse.ArgumentParser())
+        opts = p.parse_args(["--store", str(tmp_path),
+                             "--suggest", "3"])
+        assert cmd["run"](opts) == 0
+
+        # atlas re-aggregation after analyze --resume: unchanged.
+        # test_fn rebuilds the same checker stack from the spec (the
+        # suite-builder path analyze_cmd wires for real runs)
+        from jepsen_tpu import resume as jresume
+
+        def set_test_fn(opts):
+            return {"checker": sets_wl.workload(
+                {"ops": opts.get("ops", 40)})["checker"]}
+
+        before = {k: v["runs"] for k, v in cells.items()}
+        jresume.analyze_run(t2["store_dir"], resume=True,
+                            test_fn=set_test_fn)
+        entries2 = coverage.read_atlas(
+            tmp_path / coverage.ATLAS_FILE)
+        after = {k: v["runs"]
+                 for k, v in coverage.aggregate(entries2).items()}
+        assert after == before
+
+    def test_web_heatmap_smoke(self, tmp_path):
+        core.run(_lossy_set_test(tmp_path))
+        server = web.serve("127.0.0.1", 0, base=tmp_path)
+        port = server.server_address[1]
+        try:
+            base = f"http://127.0.0.1:{port}"
+            page = urllib.request.urlopen(
+                base + "/coverage/").read().decode()
+            assert "coverage atlas" in page
+            assert "cov-set" not in page  # runs live on cell pages
+            cell = urllib.request.urlopen(
+                base + "/coverage/none/set").read().decode()
+            assert "set-lost" in cell
+            assert "cov-set" in cell  # deep link to witnessing run
+            home = urllib.request.urlopen(base + "/").read().decode()
+            assert "/coverage/" in home
+        finally:
+            server.shutdown()

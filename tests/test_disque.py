@@ -1,0 +1,214 @@
+"""Disque suite tests: DB command emission via the dummy remote, a
+scripted disque CLI, and clusterless end-to-end queue runs (mirrors
+aphyr/jepsen disque/src/jepsen/disque.clj)."""
+
+import threading
+
+from jepsen_tpu import control, core, suites, testing
+from jepsen_tpu import generator as gen
+from jepsen_tpu.control.core import Action, Result
+from jepsen_tpu.control.dummy import DummyRemote
+from jepsen_tpu.suites import disque as dq
+
+
+def responder(node, action):
+    if action.cmd.startswith("stat "):
+        return Result(exit=1, out="", err="no such file",
+                      cmd=action.cmd)
+    if action.cmd.startswith("dirname "):
+        return action.cmd.split()[-1].rsplit("/", 1)[0]
+    if action.cmd.startswith("ls -A"):
+        return "disque-1.0-rc1"
+    return None
+
+
+class TestRegistry:
+    def test_disque_registered(self):
+        assert "disque" in suites.SUITES
+        assert suites.load("disque") is dq
+
+    def test_unknown_suite_raises(self):
+        import pytest
+
+        with pytest.raises(KeyError):
+            suites.load("no-such-db")
+
+
+class TestDB:
+    def test_setup_commands(self):
+        remote = DummyRemote(responder)
+        test = testing.noop_test()
+        test.update(nodes=["n1", "n2", "n3"], remote=remote,
+                    sessions={n: remote.connect({"host": n})
+                              for n in ["n1", "n2", "n3"]})
+        db = dq.DisqueDB("1.0-rc1")
+        with control.with_session(test, "n2"):
+            db.setup(test, "n2")
+        got = " ; ".join(a.cmd for a in test["sessions"]["n2"].log
+                         if isinstance(a, Action))
+        assert "1.0-rc1.tar.gz" in got
+        assert "make" in got
+        assert "--port 7711" in got
+        # meets every OTHER node, not itself
+        assert "cluster meet n1 7711" in got
+        assert "cluster meet n3 7711" in got
+        assert "cluster meet n2 7711" not in got
+
+
+class FakeDisque:
+    """In-memory broker speaking disque CLI reply strings: ADDJOB
+    assigns ids, GETJOB reserves (redelivers unless ACKed), ACKJOB
+    deletes."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.jobs: dict = {}     # id -> body
+        self.order: list = []    # FIFO of unreserved ids
+        self.n = 0
+
+    def run(self, *args):
+        cmd = args[0].lower()
+        with self.lock:
+            if cmd == "addjob":
+                self.n += 1
+                jid = f"DI{self.n:08d}SQ"
+                self.jobs[jid] = args[2]
+                self.order.append(jid)
+                return jid
+            if cmd == "getjob":
+                if not self.order:
+                    return ""
+                jid = self.order.pop(0)
+                return f"{args[-1]}\n{jid}\n{self.jobs[jid]}"
+            if cmd == "ackjob":
+                self.jobs.pop(args[1], None)
+                return "1"
+            if cmd == "cluster":
+                return "OK"
+            raise AssertionError(f"unexpected {args}")
+
+
+class FakeCliFactory:
+    def __init__(self, state=None):
+        self.state = state or FakeDisque()
+
+    def __call__(self, test, node, timeout=5.0):
+        factory = self
+
+        class _C:
+            def run(self, *args):
+                return factory.state.run(*args)
+
+            def close(self):
+                pass
+
+        return _C()
+
+
+def run_queue(opts, factory):
+    w = dq.queue_workload(opts)
+    w["client"].cli_factory = factory
+    test = testing.noop_test()
+    test.update(nodes=["n1", "n2"],
+                concurrency=opts.get("concurrency", 4),
+                client=w["client"], checker=w["checker"],
+                generator=gen.clients(
+                    gen.stagger(0.0004, w["generator"])))
+    return core.run(test)
+
+
+class TestEndToEnd:
+    def test_queue_conserves(self):
+        test = run_queue({"ops": 150}, FakeCliFactory())
+        assert test["results"]["valid?"] is True
+        tq = test["results"]["total-queue"]
+        assert tq["lost-count"] == 0 and tq["unexpected-count"] == 0
+        # coverage taxonomy tags ride on the verdict
+        assert tq["anomaly-classes"]["queue-lost"] == "clean"
+
+    def test_queue_detects_lost_jobs(self):
+        class Dropping(FakeDisque):
+            def run(self, *args):
+                out = super().run(*args)
+                if args[0].lower() == "addjob" and self.n % 5 == 0:
+                    # ack'd the job, then lost it
+                    with self.lock:
+                        jid = self.order.pop()
+                        self.jobs.pop(jid, None)
+                return out
+
+        test = run_queue({"ops": 200}, FakeCliFactory(Dropping()))
+        tq = test["results"]["total-queue"]
+        assert test["results"]["valid?"] is False
+        assert tq["lost-count"] > 0
+        assert tq["anomaly-classes"]["queue-lost"] == "witnessed"
+
+    def test_unacked_getjob_redelivers_as_duplicate_never_lost(self):
+        class LostAck(FakeDisque):
+            """Every 7th GETJOB's ACK is dropped and the job
+            redelivered — the crashed-dequeue path."""
+
+            def __init__(self):
+                super().__init__()
+                self.acks = 0
+
+            def run(self, *args):
+                if args[0].lower() == "ackjob":
+                    self.acks += 1
+                    if self.acks % 7 == 0:
+                        with self.lock:
+                            if args[1] in self.jobs:
+                                self.order.append(args[1])
+                        return "1"
+                return super().run(*args)
+
+        test = run_queue({"ops": 200}, FakeCliFactory(LostAck()))
+        tq = test["results"]["total-queue"]
+        assert tq["lost-count"] == 0
+        assert tq["anomaly-classes"]["queue-lost"] == "clean"
+
+
+class TestClientErrors:
+    def test_broker_error_reply_is_definite_fail(self):
+        class Rejecting:
+            def __call__(self, test, node, timeout=5.0):
+                class _C:
+                    def run(self, *args):
+                        return "NOREPLICA Not enough reachable nodes"
+
+                    def close(self):
+                        pass
+
+                return _C()
+
+        c = dq.DisqueQueueClient(Rejecting()).open({}, "n1")
+        from jepsen_tpu.history import Op
+
+        op = Op(index=0, time=0, type="invoke", process=0,
+                f="enqueue", value=7)
+        done = c.invoke({}, op)
+        assert done.type == "fail"
+
+    def test_transport_error_on_enqueue_is_indeterminate(self):
+        class Dying:
+            def __call__(self, test, node, timeout=5.0):
+                class _C:
+                    def run(self, *args):
+                        from jepsen_tpu.control.core import RemoteError
+
+                        raise RemoteError("broken pipe", exit=1,
+                                          out="", err="broken pipe",
+                                          cmd="addjob", node=node)
+
+                    def close(self):
+                        pass
+
+                return _C()
+
+        c = dq.DisqueQueueClient(Dying()).open({}, "n1")
+        from jepsen_tpu.history import Op
+
+        op = Op(index=0, time=0, type="invoke", process=0,
+                f="enqueue", value=7)
+        done = c.invoke({}, op)
+        assert done.type == "info"

@@ -207,6 +207,26 @@ class TestRecorderGuards:
         assert not [c for c in telemetry.get().counters()
                     if c.startswith("profiler.")]
 
+    def test_span_mirror_cap_counts_drops(self, fresh, monkeypatch):
+        """The telemetry-mirror cap is configurable and never silent:
+        launches past it count profiler.<k>.spans_dropped instead of
+        vanishing (ISSUE-7 no-silent-caps satellite)."""
+        monkeypatch.setenv("JEPSEN_TPU_PROFILE_MAX_SPANS", "3")
+        p = profiler.get()
+        for _ in range(5):
+            p.finish(p.begin("wgl"))
+        spans = [s for s in telemetry.get().events()
+                 if s["name"] == "kernel:wgl"]
+        counters = telemetry.get().counters()
+        assert len(spans) == 3
+        assert counters["profiler.wgl.spans_dropped"] == 2
+        # aggregates still saw every launch
+        assert counters["profiler.wgl.launches"] == 5
+
+    def test_span_mirror_cap_default_unchanged(self, fresh):
+        assert profiler.max_mirrored_launches() == \
+            profiler.MAX_MIRRORED_LAUNCHES
+
     def test_bucket_unclaim_re_fresh(self, fresh):
         """A failed first launch releases its bucket claim, so the
         retry's real recompile records a miss, not a phantom hit."""
