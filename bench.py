@@ -466,6 +466,52 @@ def bench_monitor_overhead(n_ops=4000):
     }
 
 
+def bench_nodeprobe_overhead(n_ticks=200, n_nodes=5):
+    """Node-observability-plane tax (jepsen_tpu.nodeprobe): the probe
+    runs on its own threads with its own sessions — it never touches
+    the interpreter hot loop — so its cost is per-tick control-plane
+    work (compound /proc probe + log tail + parse + record). This
+    measures the median tick across a 5-node synthetic cluster, then
+    prices the production cadence (1 tick/node/s) against the
+    headline's 60s/1M-event budget: vs_baseline = probe-seconds per
+    budget-second (the ISSUE-9 acceptance bound is < 0.02 — no silent
+    overhead; whatever the plane costs, this line records it)."""
+    import statistics as _st
+
+    from jepsen_tpu import nodeprobe, testing, util
+    from jepsen_tpu.control.dummy import DummyRemote
+
+    nodes = [f"n{i + 1}" for i in range(n_nodes)]
+    t = testing.noop_test()
+    t.update(nodes=nodes,
+             remote=DummyRemote(nodeprobe.synthetic_responder()),
+             node_log_files=["/var/log/db.log"])
+    util.init_relative_time()
+    probe = nodeprobe.NodeProbe(t, interval_s=1.0)
+    times = []
+    for _ in range(n_ticks):
+        t0 = time.time()
+        for node in nodes:
+            probe.tick(node)
+        times.append((time.time() - t0) / n_nodes)
+    probe.stop()
+    assert probe.records()  # the plane actually sampled
+    per_tick = _st.median(times)
+    # production cadence: each node ticks once per wall second, so the
+    # plane spends (per_tick * n_nodes) probe-seconds per second
+    fraction = per_tick * n_nodes
+    _log(f"nodeprobe-overhead: {per_tick * 1e3:.2f}ms/tick across "
+         f"{n_nodes} nodes ({fraction:.4f}x of the headline budget "
+         "at the 1s production cadence)")
+    return {
+        "metric": f"node-probe tick cost ({n_nodes} synthetic nodes, "
+                  "compound /proc probe + log tail + record)",
+        "value": round(per_tick * 1e3, 3),
+        "unit": "ms",
+        "vs_baseline": round(fraction, 4),
+    }
+
+
 def bench_trace_overhead(n_ops=4000):
     """Per-op causal-tracing tax on the interpreter hot loop: the same
     dummy-client run with the tracer DISABLED (the default state — one
@@ -881,6 +927,7 @@ def main():
     if not os.environ.get("BENCH_SKIP_EXTRAS"):
         for fn, args in ((bench_monitor_overhead, ()),
                          (bench_trace_overhead, ()),
+                         (bench_nodeprobe_overhead, ()),
                          (bench_coverage_overhead,
                           (50_000 if small else 200_000,)),
                          (bench_watchdog_latency, ()),

@@ -9,9 +9,14 @@ tidb/src/tidb/core.clj:47-60) — or against real nodes over SSH.
 from __future__ import annotations
 
 from . import checker as chk
-from . import cli, testing, workloads
+from . import cli, nodeprobe, testing, workloads
 from . import generator as gen
 from . import nemesis as jnemesis
+
+# the synthetic DB log every clusterless demo node "writes" — the node
+# probe tails it and tags seeded election/OOM lines, so demo runs
+# exercise the full node-plane path (jepsen_tpu.nodeprobe)
+DEMO_LOG = "/var/log/db.log"
 
 # --nemesis packages for clusterless demo runs: the faults fire
 # against the dummy control plane (commands logged, nothing disturbed,
@@ -28,23 +33,36 @@ NEMESES = {
 }
 
 
-def _demo_responder(node, action):
-    """Canned command output for clusterless nemesis runs: the
-    partitioner resolves node IPs (getent) and discovers the primary
-    device (ip link) before issuing its iptables commands — answer
-    both so faults fire against the dummy control plane instead of
-    crashing the nemesis process."""
-    cmd = action.cmd
-    if cmd.startswith("getent ahostsv4"):
-        host = cmd.split()[-1]
-        digits = "".join(ch for ch in str(host) if ch.isdigit())
-        n = int(digits) % 250 + 1 if digits else \
-            sum(str(host).encode()) % 250 + 1
-        return f"10.0.0.{n}   STREAM {host}"
-    if cmd == "ip -o link show":
-        return ("1: lo: <LOOPBACK,UP> mtu 65536\n"
-                "2: eth0: <BROADCAST,MULTICAST,UP> mtu 1500")
-    return None
+def _make_demo_responder():
+    """A demo responder: answers the partitioner's discovery commands
+    (getent node-IP resolution, ip-link device discovery — so faults
+    fire against the dummy control plane instead of crashing the
+    nemesis process) and the node probe's compound /proc + log-tail
+    command with seeded synthetic node state. Each test built by
+    make_test gets its OWN instance, so a second run in the same
+    process re-seeds tick counters and the synthetic log instead of
+    re-tailing the previous run's content at stale timestamps."""
+    synth = nodeprobe.synthetic_responder()
+
+    def respond(node, action):
+        cmd = action.cmd
+        if cmd.startswith("getent ahostsv4"):
+            host = cmd.split()[-1]
+            digits = "".join(ch for ch in str(host) if ch.isdigit())
+            n = int(digits) % 250 + 1 if digits else \
+                sum(str(host).encode()) % 250 + 1
+            return f"10.0.0.{n}   STREAM {host}"
+        if cmd == "ip -o link show":
+            return ("1: lo: <LOOPBACK,UP> mtu 65536\n"
+                    "2: eth0: <BROADCAST,MULTICAST,UP> mtu 1500")
+        return synth(node, action)
+
+    return respond
+
+
+# module-level instance for direct importers (tests that just need
+# the discovery answers); make_test builds a fresh one per test
+_demo_responder = _make_demo_responder()
 
 # workload name -> in-memory client factory (testing.py fixtures)
 CLIENTS = {
@@ -158,17 +176,29 @@ def make_test(opts: dict) -> dict:
         # xprof/TensorBoard) of the analysis phase into the run's
         # store dir (doc/observability.md)
         test["xla-trace?"] = True
+    if not opts.get("no_nodeprobe"):
+        # the node observability plane is on by default: per-node
+        # resource/clock/log sampling into nodes.jsonl, clusterless
+        # demo nodes answering with seeded synthetic /proc data
+        test["nodeprobe?"] = True
+        if opts.get("nodeprobe_interval"):
+            test["nodeprobe_interval_s"] = float(
+                opts["nodeprobe_interval"])
+        if (opts.get("ssh") or {}).get("dummy"):
+            test["node_log_files"] = [DEMO_LOG]
     nem_name = opts.get("nemesis") or "none"
     if nem_name not in NEMESES:
         raise SystemExit(f"unknown nemesis {nem_name!r}; "
                          + cli.one_of(NEMESES))
     if nem_name != "none":
         test["nemesis"] = NEMESES[nem_name]()
-        if (opts.get("ssh") or {}).get("dummy") and not test.get(
-                "remote"):
-            from .control.dummy import DummyRemote
+    if (opts.get("ssh") or {}).get("dummy") and not test.get("remote"):
+        # the demo responder answers BOTH the partitioner's discovery
+        # commands and the node probe's compound /proc probe; a fresh
+        # instance per test keeps synthetic node state run-scoped
+        from .control.dummy import DummyRemote
 
-            test["remote"] = DummyRemote(_demo_responder)
+        test["remote"] = DummyRemote(_make_demo_responder())
     for k, v in w.items():
         if k not in ("generator", "checker", "final_generator"):
             test[k] = v
@@ -248,6 +278,13 @@ def _workload_opt(p):
                    help="Drop an XLA profiler trace of the analysis "
                         "phase into the run's store dir "
                         "(<run>/xla-trace, xprof/TensorBoard format).")
+    p.add_argument("--no-nodeprobe", action="store_true",
+                   help="Disable the node observability plane "
+                        "(per-node resource/clock/log sampling into "
+                        "nodes.jsonl; see doc/observability.md).")
+    p.add_argument("--nodeprobe-interval", type=float, default=None,
+                   metavar="SECS",
+                   help="Node probe tick interval (default 1s).")
     p.add_argument("--nemesis", default="none",
                    help="Fault package to run against the workload "
                         "(coverage atlas column). " + cli.one_of(
@@ -266,6 +303,7 @@ def main(argv=None) -> None:
     commands.update(cli.serve_cmd())
     commands.update(cli.telemetry_cmd())
     commands.update(cli.profile_cmd())
+    commands.update(cli.nodes_cmd())
     commands.update(cli.trace_cmd())
     commands.update(cli.analyze_cmd(make_test))
     commands.update(cli.coverage_cmd(list(workloads.REGISTRY)))

@@ -354,6 +354,42 @@ def _store_run_opts(p: argparse.ArgumentParser) -> argparse.ArgumentParser:
     return p
 
 
+def nodes_cmd() -> dict:
+    """A 'nodes' subcommand: the node observability plane's per-node
+    summary for a stored run — sample/gap counts, resource extremes,
+    tagged DB-log events, breaker badges, and the merged clock-skew
+    bound (nodes.jsonl, jepsen_tpu.nodeprobe; doc/observability.md)."""
+    def build(p):
+        return _store_run_opts(p)
+
+    def run(options):
+        from . import nodeprobe as jnodeprobe
+        from . import store as jstore
+        from .reports import nodes as rnodes
+
+        d = _resolve_stored_run(options)
+        if d is None:
+            print(f"no such stored test: {options.test}")
+            return 254
+        records = jstore.load_nodes(d)
+        if not records:
+            print(f"no node-plane records under {d} "
+                  "(run predates — or disabled — the node probe)")
+            return 1
+        jnodeprobe.validate_records(records)
+        test = None
+        try:
+            test = jstore.load(d)
+        except (OSError, ValueError):
+            pass
+        print(f"# {d.resolve()}\n")
+        print(rnodes.nodes_text(records,
+                                (test or {}).get("history")))
+        return 0
+
+    return {"nodes": {"parser_fn": build, "run": run}}
+
+
 def trace_cmd() -> dict:
     """A 'trace' subcommand: exports a stored run as Chrome-trace JSON
     (trace.json) openable in ui.perfetto.dev — telemetry spans, op

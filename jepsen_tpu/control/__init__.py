@@ -51,15 +51,19 @@ def conn_spec(test: dict, node) -> dict:
     }
 
 
-def remote_for(test: dict) -> Remote:
+def remote_for(test: dict, guarded: bool = True) -> Remote:
     r = test.get("remote")
     if r is None:
         r = dummy if (test.get("ssh") or {}).get("dummy") else _default_ssh()
     hr = test.get("health")
-    if hr is not None:
+    if hr is not None and guarded:
         # per-node circuit breakers (control/health.py): commands to a
         # quarantined node fail fast instead of burning retry budgets,
-        # and the run continues :degraded instead of aborting
+        # and the run continues :degraded instead of aborting.
+        # guarded=False bypasses the wrapper for OBSERVERS (the node
+        # probe): background traffic must neither trip a breaker nor
+        # reset its consecutive-failure count — only real work feeds
+        # the circuit (the advisory-only contract, doc/observability.md)
         from .health import GuardedRemote
         r = GuardedRemote(r, hr)
     return r
@@ -76,8 +80,9 @@ def _default_ssh() -> Remote:
     return RetryingRemote(ScpRemote(SshRemote()))
 
 
-def session(test: dict, node) -> Session:
-    return remote_for(test).connect(conn_spec(test, node))
+def session(test: dict, node, guarded: bool = True) -> Session:
+    return remote_for(test, guarded=guarded).connect(
+        conn_spec(test, node))
 
 
 def disconnect(sess: Session) -> None:

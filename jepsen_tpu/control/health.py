@@ -63,6 +63,20 @@ class CircuitBreaker:
         with self._lock:
             return self._open_since is not None
 
+    def state(self) -> str:
+        """'closed', 'open', or 'half-open' (cooldown elapsed: the
+        next command probes, or one already is) — the per-node badge
+        the web run page and the node plane's breaker records surface
+        (jepsen_tpu.nodeprobe)."""
+        with self._lock:
+            if self._open_since is None:
+                return "closed"
+            if (self._probing
+                    or _time.monotonic() - self._open_since
+                    >= self.cooldown_s):
+                return "half-open"
+            return "open"
+
     def admit(self) -> bool:
         """May a command proceed? False = quarantined (fail fast).
         In the half-open window exactly one caller is admitted as the
@@ -74,8 +88,14 @@ class CircuitBreaker:
                     and _time.monotonic() - self._open_since
                     >= self.cooldown_s):
                 self._probing = True  # this caller probes
-                return True
-            return False
+                granted = True
+            else:
+                granted = False
+        if granted:
+            # the open -> half-open transition, next to the opened/
+            # healed counters (state transitions as telemetry)
+            telemetry.count("control.quarantine.half-open")
+        return granted
 
     def success(self) -> None:
         with self._lock:
@@ -123,6 +143,7 @@ class HealthRegistry:
         self.cooldown_s = cooldown_s
         self._lock = threading.Lock()
         self._breakers: dict = {}
+        self._advisories: dict = {}
 
     @classmethod
     def from_test(cls, test: dict) -> "HealthRegistry":
@@ -140,6 +161,32 @@ class HealthRegistry:
                 b = self._breakers[node] = CircuitBreaker(
                     node, self.threshold, self.cooldown_s)
             return b
+
+    def states(self) -> dict:
+        """{node: breaker state} for every node a breaker exists for —
+        the telemetry view the node plane records as `breaker`
+        transitions and the web run page badges."""
+        with self._lock:
+            breakers = list(self._breakers.values())
+        return {b.node: b.state() for b in breakers}
+
+    def advise(self, node, reason: str, value=None) -> None:
+        """An ADVISORY health signal from the node observability plane
+        (jepsen_tpu.nodeprobe: low memory, cpu saturation). Logged and
+        counted, never a breaker verdict — a loaded node is not a dead
+        node, and metrics must not trip circuits (transport failures
+        alone do that)."""
+        with self._lock:
+            self._advisories.setdefault(node, {})[str(reason)] = value
+        telemetry.count("control.health.advisories")
+        telemetry.count(f"control.health.advisory.{reason}")
+        logger.warning("node %s health advisory: %s (%r) — advisory "
+                       "only, circuit unaffected", node, reason, value)
+
+    def advisories(self) -> dict:
+        """{node: {reason: last value}} of advisories received."""
+        with self._lock:
+            return {n: dict(v) for n, v in self._advisories.items()}
 
     def quarantined(self) -> list:
         """Nodes whose circuit is currently open."""

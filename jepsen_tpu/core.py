@@ -385,6 +385,26 @@ def analyze(test: dict, store_ctx=None, extra_opts: dict | None = None
                     map(str, hr.ever_quarantined())),
                 "still-quarantined": sorted(
                     map(str, hr.quarantined()))}
+        # realtime-order verdicts (wgl linearizability, elle strict
+        # variants) carry the clock skew actually measured during the
+        # run: the node probe's per-tick offsets merged with the
+        # history's check-offsets observations (jepsen_tpu.nodeprobe).
+        # Works offline too — `analyze` re-reads nodes.jsonl.
+        try:
+            from . import nodeprobe as jnodeprobe
+
+            nprobe = test.get("nodeprobe")
+            recs = (nprobe.records() if nprobe is not None
+                    else jnodeprobe.load_records(test.get("store_dir")))
+            bound = jnodeprobe.clock_skew_bound(recs,
+                                                test.get("history"))
+            if bound is not None:
+                n = jnodeprobe.stamp_results(test["results"], bound)
+                test["results"]["clock-skew-bound"] = bound
+                logger.info("clock-skew-bound %.3fs stamped on %d "
+                            "realtime verdict(s)", bound, n)
+        except Exception:  # noqa: BLE001 — stamping is best-effort
+            logger.exception("stamping clock-skew-bound failed")
     logger.info("Analysis complete")
     return test
 
@@ -466,6 +486,24 @@ def run(test: dict) -> dict:
                         test["health"] = jhealth.HealthRegistry.from_test(
                             test)
                     test = control.open_sessions(test)
+                    # the node observability plane: a per-node
+                    # resource/clock-skew/DB-log sampler over its own
+                    # control sessions, appending nodes.jsonl
+                    # (jepsen_tpu.nodeprobe; opt-in via
+                    # test["nodeprobe?"], on by default in the demo CLI)
+                    nprobe = None
+                    if test.get("nodeprobe?") and test.get("store_dir"):
+                        try:
+                            from . import nodeprobe as jnodeprobe
+                            nprobe = jnodeprobe.NodeProbe(test)
+                            test["nodeprobe"] = nprobe
+                            nprobe.start(Path(test["store_dir"])
+                                         / jnodeprobe.NODES_FILE)
+                        except Exception:  # noqa: BLE001 — never
+                            # sink the run for observability
+                            logger.exception("starting node probe "
+                                             "failed")
+                            nprobe = None
                     try:
                         with telemetry.span("os-setup"):
                             _setup_os(test)
@@ -486,6 +524,14 @@ def run(test: dict) -> dict:
                             with telemetry.span("teardown-os"):
                                 _teardown_os(test)
                     finally:
+                        # the probe's final offsets/events land before
+                        # analysis so the skew bound sees the full run
+                        if nprobe is not None:
+                            try:
+                                nprobe.stop()
+                            except Exception:  # noqa: BLE001
+                                logger.exception("stopping node probe "
+                                                 "failed")
                         control.close_sessions(test)
 
                 # checkers read optrace.jsonl (timeline hover, trace

@@ -121,7 +121,12 @@ class ClockNemesis(Nemesis):
         {'f': 'bump',   'value': {node: delta_ms, ...}}
         {'f': 'check-offsets'}
 
-    Completions carry 'clock-offsets' {node: seconds}."""
+    Completions carry 'clock-offsets' {node: seconds}. The node
+    observability plane merges these observations with its own
+    per-tick offset readings (same clock_offset math) into the skew
+    series that clock plots, Perfetto node tracks, and the
+    `clock-skew-bound` on realtime verdicts are built from
+    (jepsen_tpu.nodeprobe.clock_series)."""
 
     def setup(self, test):
         def body(t, n):

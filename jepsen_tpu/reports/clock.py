@@ -49,11 +49,36 @@ def short_node_names(nodes) -> list:
     return [".".join(s[:len(s) - k]) for s in split]
 
 
+def merge_nodeprobe(datasets: dict, test) -> dict:
+    """Folds the node probe's per-tick clock offsets (nodes.jsonl,
+    jepsen_tpu.nodeprobe) into the check-offsets datasets, so the skew
+    plot shows the continuously-sampled series, not just the nemesis's
+    occasional observations. Points merge time-sorted per node."""
+    from .. import nodeprobe
+
+    records = nodeprobe.load_records(test.get("store_dir"))
+    if not records:
+        return datasets
+    merged = nodeprobe.clock_series(records)  # probe points only —
+    # the history's check-offsets already live in `datasets`
+    if not merged:
+        return datasets
+    out = {n: list(pts) for n, pts in datasets.items()}
+    for node, pts in merged.items():
+        out.setdefault(node, []).extend(
+            [util.nanos_to_secs(t), off] for t, off in pts)
+    for pts in out.values():
+        pts.sort(key=lambda p: p[0])
+    return out
+
+
 def plot(test, history, opts=None) -> dict:
-    """Writes clock-skew.png (clock.clj plot!)."""
+    """Writes clock-skew.png (clock.clj plot!): the history's
+    check-offsets observations merged with the node probe's sampled
+    offset series."""
     if not (test.get("store_dir") or test.get("name")):
         return {"valid?": True, "skipped": "no store directory"}
-    datasets = history_to_datasets(history)
+    datasets = merge_nodeprobe(history_to_datasets(history), test)
     if not datasets:
         return {"valid?": True}
     nodes = sorted(datasets, key=str)

@@ -160,18 +160,91 @@ def _load_by_op(store_dir, optrace):
     return jtracing.by_op(optrace or [])
 
 
+# node-plane context around an anomaly: events this far outside the
+# ops' own window still make the excerpt (an OOM-kill 2s before the
+# lost write is exactly the context the excerpt exists for)
+_NODE_CONTEXT_SLACK_NS = 2_000_000_000
+_NODE_CONTEXT_LIMIT = 16
+
+
+def node_context_lines(noderecs, t0_ns: int, t1_ns: int,
+                       slack_ns: int = _NODE_CONTEXT_SLACK_NS
+                       ) -> list[str]:
+    """Text lines for the node observability plane's events (tagged
+    DB-log lines, probe gaps, breaker transitions — jepsen_tpu.
+    nodeprobe) inside [t0-slack, t1+slack]: what the NODES were doing
+    while the anomaly's ops ran. Empty when the run had no node plane
+    or nothing happened in the window."""
+    lo, hi = t0_ns - slack_ns, t1_ns + slack_ns
+    picked = []
+    for rec in noderecs or []:
+        kind = rec.get("kind")
+        if kind not in ("log", "gap", "breaker"):
+            continue
+        t = rec.get("t", 0)
+        if not lo <= t <= hi:
+            continue
+        if kind == "log":
+            desc = (f"{rec.get('class')} ({rec.get('ts')} ts): "
+                    f"{str(rec.get('line'))[:140]}")
+        elif kind == "gap":
+            desc = f"probe gap: {rec.get('reason')}"
+        else:
+            desc = f"breaker -> {rec.get('state')}"
+        picked.append((t, f"  t={t / 1e9:+.3f}s {rec.get('node')}: "
+                          f"{desc}"))
+    if not picked:
+        return []
+    picked.sort()
+    lines = ["", f"node events in the op window ({len(picked)}; "
+                 "jepsen_tpu.nodeprobe):"]
+    lines.extend(line for _t, line in picked[:_NODE_CONTEXT_LIMIT])
+    if len(picked) > _NODE_CONTEXT_LIMIT:
+        lines.append(f"  … {len(picked) - _NODE_CONTEXT_LIMIT} "
+                     "more event(s)")
+    return lines
+
+
+def _op_window(by_op: dict, indices) -> tuple[int, int] | None:
+    """The [min t0, max t1] span of the trace records behind the given
+    op indices — the anomaly's op window node context keys on."""
+    t0 = t1 = None
+    for i in indices:
+        for rec in by_op.get(i) or []:
+            a = rec.get("t0")
+            b = rec.get("t1", a)
+            if a is None:
+                continue
+            t0 = a if t0 is None else min(t0, a)
+            t1 = b if t1 is None else max(t1, b if b is not None
+                                          else a)
+    return (t0, t1) if t0 is not None else None
+
+
+def _load_noderecs(store_dir, noderecs):
+    if noderecs is not None:
+        return noderecs
+    from .. import nodeprobe
+
+    return nodeprobe.load_records(store_dir)
+
+
 def write_trace_excerpts(store_dir, result: dict, optrace=None,
-                         subdir: str = "elle") -> list[str]:
+                         subdir: str = "elle",
+                         noderecs=None) -> list[str]:
     """Resolves each anomaly's op-indices into a per-anomaly trace
     excerpt file (<name>-trace-<fp>.txt next to the anomaly files);
-    returns the written paths. No-op when the run wasn't traced or no
-    record carries op-indices."""
+    when the run carried the node observability plane (nodes.jsonl),
+    the node events inside the anomaly's op window ride along in the
+    same excerpt. Returns the written paths. No-op when the run wasn't
+    traced or no record carries op-indices."""
     anomalies = (result or {}).get("anomalies") or {}
     if not anomalies:
         return []
     by_op = _load_by_op(store_dir, optrace)
     if not by_op:
         return []
+    noderecs = _load_noderecs(store_dir, noderecs)
     out_dir = Path(store_dir) / subdir
     fp = _fingerprint(sorted((k, repr(v)) for k, v in anomalies.items()))
     written: list[str] = []
@@ -183,6 +256,9 @@ def write_trace_excerpts(store_dir, result: dict, optrace=None,
         body = [f"{name}: trace excerpts for participating ops "
                 f"{idxs}", ""]
         body.extend(trace_excerpt_lines(by_op, idxs))
+        window = _op_window(by_op, idxs)
+        if window is not None:
+            body.extend(node_context_lines(noderecs, *window))
         out_dir.mkdir(parents=True, exist_ok=True)
         p = out_dir / f"{name}-trace-{fp}.txt"
         p.write_text("\n".join(body) + "\n")
@@ -206,6 +282,10 @@ def write_linear_trace_excerpt(store_dir, analysis: dict,
     body = [f"linearizability counterexample: trace excerpts for "
             f"participating ops {sorted(idxs)}", ""]
     body.extend(trace_excerpt_lines(by_op, sorted(idxs)))
+    window = _op_window(by_op, sorted(idxs))
+    if window is not None:
+        body.extend(node_context_lines(
+            _load_noderecs(store_dir, None), *window))
     p = Path(store_dir) / f"linear-counterexample-trace-{fp}.txt"
     p.write_text("\n".join(body) + "\n")
     return str(p)

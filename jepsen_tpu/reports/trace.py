@@ -25,6 +25,12 @@ events, per the trace-event spec):
   device   one track per compiled kernel (wgl, scc, ...): each launch
            record (jepsen_tpu.tpu.profiler) is a slice carrying its
            FLOPs/bytes/phase-split attrs
+  node <n> one process per DB node (jepsen_tpu.nodeprobe): counter
+           tracks (`C` events) for CPU/memory/network/clock-offset —
+           the offset series merges the probe ticks with the
+           history's check-offsets observations — plus instant
+           markers for tagged DB-log events, probe gaps, and
+           quarantine-breaker transitions
 
 CLI: `python -m jepsen_tpu trace <run>` writes `trace.json` into the
 run's store directory (see doc/observability.md for the walkthrough);
@@ -52,6 +58,7 @@ _PID_HARNESS = 1
 _PID_CLIENTS = 2
 _PID_NEMESIS = 3
 _PID_DEVICE = 4
+_PID_NODE_BASE = 10  # node i gets pid _PID_NODE_BASE + i
 
 
 def _us(ns: int) -> float:
@@ -252,6 +259,80 @@ def _nemesis_events(events: list, test, history) -> int:
     return n
 
 
+def _node_events(events: list, noderecs, history=None) -> int:
+    """Node-plane records (jepsen_tpu.nodeprobe) as one process per DB
+    node: `C` counter events for the resource series, instant markers
+    for log events / gaps / breaker transitions. The clock-offset
+    counter uses the MERGED series (probe ticks + the history's
+    check-offsets observations), so skew readings that previously sat
+    unrendered in the history finally land on the timeline."""
+    from .. import nodeprobe
+
+    noderecs = list(noderecs or [])
+    offsets = nodeprobe.clock_series(noderecs, history)
+    nodes = sorted({str(r.get("node")) for r in noderecs}
+                   | set(offsets))
+    if not nodes:
+        return 0
+    n = 0
+    for i, node in enumerate(nodes):
+        pid = _PID_NODE_BASE + i
+        _process_meta(events, pid, f"node {node}")
+        tids = _Tids(events, pid, sort_index=10 + i)
+        mark_tid = tids.tid("events")
+
+        def counter(name, t, value):
+            events.append({"ph": "C", "name": name, "pid": pid,
+                           "tid": tids.tid(name), "ts": _us(t),
+                           "args": {name: value}})
+
+        for t, off in offsets.get(node, []):
+            counter("clock_offset_ms", t, round(off * 1e3, 3))
+            n += 1
+        for rec in noderecs:
+            if str(rec.get("node")) != node:
+                continue
+            kind = rec.get("kind")
+            t = rec.get("t", 0)
+            if kind == "sample":
+                busy = (rec.get("cpu") or {}).get("busy")
+                if busy is not None:
+                    counter("cpu_busy", t, busy)
+                used = (rec.get("mem") or {}).get("used_frac")
+                if used is not None:
+                    counter("mem_used_frac", t, used)
+                net = rec.get("net") or {}
+                if "rx_bytes_s" in net:
+                    counter("net_rx_bytes_s", t, net["rx_bytes_s"])
+                if "tx_bytes_s" in net:
+                    counter("net_tx_bytes_s", t, net["tx_bytes_s"])
+                n += 1
+            elif kind == "log":
+                events.append({
+                    "ph": "i", "s": "t", "cat": "node-log",
+                    "name": f"log:{rec.get('class')}",
+                    "pid": pid, "tid": mark_tid, "ts": _us(t),
+                    "args": {"file": str(rec.get("file")),
+                             "line": str(rec.get("line"))[:200],
+                             "ts_source": str(rec.get("ts"))}})
+                n += 1
+            elif kind == "gap":
+                events.append({
+                    "ph": "i", "s": "t", "cat": "node-gap",
+                    "name": f"gap:{rec.get('reason')}",
+                    "pid": pid, "tid": mark_tid, "ts": _us(t),
+                    "args": {}})
+                n += 1
+            elif kind == "breaker":
+                events.append({
+                    "ph": "i", "s": "t", "cat": "node-breaker",
+                    "name": f"breaker:{rec.get('state')}",
+                    "pid": pid, "tid": mark_tid, "ts": _us(t),
+                    "args": {}})
+                n += 1
+    return n
+
+
 def expand_op_filter(history, ops) -> set | None:
     """An anomaly's op references may be completion indices; the trace
     and timeline join on invocation indices. Expands the given index
@@ -274,11 +355,12 @@ def expand_op_filter(history, ops) -> set | None:
 
 
 def chrome_trace(test: dict | None, history, spans,
-                 optrace=None, ops=None) -> dict:
+                 optrace=None, ops=None, noderecs=None) -> dict:
     """The complete trace document for a run. `test` may be the loaded
     test.json dict (for nemesis plot specs), `history` a History or op
     list, `spans` telemetry span records, `optrace` per-op trace
-    records (jepsen_tpu.tracing). `ops`: restrict the client tracks to
+    records (jepsen_tpu.tracing), `noderecs` node-plane records
+    (jepsen_tpu.nodeprobe). `ops`: restrict the client tracks to
     these op indices — the pre-filtered anomaly drill-down view."""
     history = history if history is not None else []
     ops_filter = expand_op_filter(history, ops)
@@ -288,9 +370,10 @@ def chrome_trace(test: dict | None, history, spans,
     tids = _op_events(events, history, ops_filter)
     n_rec = _optrace_events(events, tids, optrace, ops_filter)
     n_nem = _nemesis_events(events, test, history)
+    n_node = _node_events(events, noderecs, history)
     logger.info("trace: %d spans, %d device launches, %d optrace "
-                "records, %d nemesis windows", n_spans, n_dev, n_rec,
-                n_nem)
+                "records, %d nemesis windows, %d node records",
+                n_spans, n_dev, n_rec, n_nem, n_node)
     return {"traceEvents": events,
             "displayTimeUnit": "ms",
             "otherData": {"source": "jepsen_tpu",
@@ -309,8 +392,9 @@ def write_trace(run_dir, out_path=None, ops=None) -> Path:
     test = jstore.load(d)
     events, _metrics = jstore.load_telemetry(d)
     optrace = jstore.load_optrace(d)
+    noderecs = jstore.load_nodes(d)
     doc = chrome_trace(test, test.get("history") or [], events,
-                       optrace=optrace, ops=ops)
+                       optrace=optrace, ops=ops, noderecs=noderecs)
     out = Path(out_path) if out_path else d / TRACE_JSON
     with open(out, "w") as f:
         json.dump(doc, f)
@@ -330,7 +414,7 @@ def validate_chrome_trace(doc: dict) -> int:
     named_tids: set = set()
     for i, ev in enumerate(events):
         ph = ev.get("ph")
-        if ph not in ("X", "M", "i"):
+        if ph not in ("X", "M", "i", "C"):
             raise ValueError(f"event {i}: unknown ph {ph!r}")
         if "name" not in ev or "pid" not in ev:
             raise ValueError(f"event {i}: missing name/pid: {ev}")
@@ -349,6 +433,16 @@ def validate_chrome_trace(doc: dict) -> int:
             dur = ev.get("dur")
             if not isinstance(dur, (int, float)) or dur < 0:
                 raise ValueError(f"event {i}: bad dur {dur!r}")
+        if ph == "C":
+            # counter events (node resource/skew series): args is the
+            # series map and every value must be numeric
+            args = ev.get("args")
+            if not isinstance(args, dict) or not args or not all(
+                    isinstance(v, (int, float))
+                    for v in args.values()):
+                raise ValueError(
+                    f"counter event {i}: non-numeric args "
+                    f"{ev.get('args')!r}")
         if ev["pid"] not in named_pids:
             raise ValueError(f"event {i}: pid {ev['pid']} unnamed")
         if (ev["pid"], ev.get("tid")) not in named_tids:

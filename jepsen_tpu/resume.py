@@ -36,7 +36,8 @@ logger = logging.getLogger(__name__)
 # analysis must not touch (no cluster exists anymore)
 _LIFECYCLE_KEYS = ("client", "generator", "final_generator", "nemesis",
                    "db", "os", "remote", "sessions", "barrier",
-                   "history_writer", "monitor", "watchdog", "net")
+                   "history_writer", "monitor", "watchdog", "net",
+                   "nodeprobe")
 
 
 def recover_history(d):

@@ -24,6 +24,9 @@ Layout:
     trace.json     Chrome-trace/Perfetto export (reports/trace.py, on demand)
     coverage.json  per-run fault × workload × anomaly coverage record
                    (jepsen_tpu.coverage, doc/observability.md)
+    nodes.jsonl    node observability plane: per-node resource samples,
+                   clock offsets, tagged DB-log events, honest gap
+                   markers (jepsen_tpu.nodeprobe, when test["nodeprobe?"])
     <node>/...     downloaded node logs (core.snarf_logs)
   store/<name>/latest  -> most recent run   store/latest -> same
   store/current        -> run in progress
@@ -51,7 +54,8 @@ BASE = Path("store")
 _SKIP_KEYS = {"history", "results", "barrier", "db", "client", "nemesis",
               "checker", "generator", "os", "remote", "sessions",
               "history_writer", "store_dir", "_log_handler",
-              "monitor", "watchdog", "monitor_probes", "health"}
+              "monitor", "watchdog", "monitor_probes", "health",
+              "nodeprobe"}
 
 
 def base_dir(test: dict | None = None) -> Path:
@@ -240,6 +244,16 @@ def load_optrace(d) -> list[dict]:
     from .. import tracing as jtracing
 
     return list(jtracing.read_records(Path(d) / jtracing.TRACE_FILE))
+
+
+def load_nodes(d) -> list[dict]:
+    """Node-plane records (samples, gaps, log events, breaker
+    transitions) from a stored test dir's nodes.jsonl
+    (jepsen_tpu.nodeprobe); [] when the run predates (or disabled)
+    the probe."""
+    from .. import nodeprobe as jnodeprobe
+
+    return jnodeprobe.load_records(d)
 
 
 def load_timeseries(d) -> list[dict]:

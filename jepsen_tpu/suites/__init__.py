@@ -23,6 +23,7 @@ SUITES = {
     "postgres": "jepsen_tpu.suites.postgres",
     "rabbitmq": "jepsen_tpu.suites.rabbitmq",
     "raftis": "jepsen_tpu.suites.raftis",
+    "rethinkdb": "jepsen_tpu.suites.rethinkdb",
     "stolon": "jepsen_tpu.suites.stolon",
     "tidb": "jepsen_tpu.suites.tidb",
     "yugabyte": "jepsen_tpu.suites.yugabyte",

@@ -322,6 +322,34 @@ def _profile_html(d: Path, rel: str) -> str:
             "prometheus metrics</a></p>")
 
 
+def _nodes_html(d: Path) -> str:
+    """The per-node observability lanes (jepsen_tpu.nodeprobe):
+    resource strips + DB-log event markers + gap/breaker ticks under
+    the run's nemesis fault windows (from its coverage record)."""
+    from . import coverage as jcoverage
+    from .reports import nodes as rnodes
+
+    records = jstore.load_nodes(d)
+    if not records:
+        return ""
+    faults = (jcoverage.load_record(d) or {}).get("faults")
+    # the MERGED skew bound (probe + check-offsets) the verdict was
+    # stamped with, from results.json — cheaper than re-reading the
+    # history, and guaranteed consistent with what the verdict says
+    bound = None
+    try:
+        res = jstore.load_results(d)
+        if isinstance(res, dict):
+            bound = res.get("clock-skew-bound")
+    except (OSError, json.JSONDecodeError):
+        pass
+    try:
+        return rnodes.lanes_html(records, faults, bound=bound)
+    except Exception:  # noqa: BLE001 — lanes must not 500 the page
+        logger.exception("rendering node lanes failed")
+        return ""
+
+
 def dir_html(rel: str, d: Path) -> str:
     entries = sorted(d.iterdir(),
                      key=lambda p: (not p.is_dir(), p.name))
@@ -332,6 +360,7 @@ def dir_html(rel: str, d: Path) -> str:
     views = ""
     anomalies = ""
     profile = ""
+    nodes = ""
     if (d / "test.json").exists():
         # a run directory: link its rendered views next to the raw files
         run_rel = _html.escape(rel.rstrip("/"))
@@ -339,6 +368,7 @@ def dir_html(rel: str, d: Path) -> str:
                  f"</a> · <a href='/live/{run_rel}'>live</a> · "
                  f"<a href='/trace/{run_rel}'>perfetto json</a></p>")
         anomalies = _anomaly_html(rel.rstrip("/"), d)
+        nodes = _nodes_html(d)
         profile = _profile_html(d, rel.rstrip("/"))
     return (f"<!DOCTYPE html><html><head><style>"
             "table { border-collapse: collapse } "
@@ -346,7 +376,7 @@ def dir_html(rel: str, d: Path) -> str:
             "border-bottom: 1px solid #eee; font-size: 13px }"
             "</style></head><body>"
             f"<h1>{_html.escape(rel)}</h1>"
-            f"{views}{anomalies}{profile}<ul>{items}</ul>"
+            f"{views}{anomalies}{nodes}{profile}<ul>{items}</ul>"
             "</body></html>")
 
 
@@ -584,9 +614,10 @@ class StoreHandler(BaseHTTPRequestHandler):
                     test = jstore.load(p)
                     events, _m = jstore.load_telemetry(p)
                     optrace = jstore.load_optrace(p)
+                    noderecs = jstore.load_nodes(p)
                     doc = rtrace.chrome_trace(
                         test, test.get("history") or [], events,
-                        optrace=optrace, ops=ops)
+                        optrace=optrace, ops=ops, noderecs=noderecs)
                     self._send(200, json.dumps(doc).encode(),
                                "application/json")
             elif path == "/coverage" or path.startswith("/coverage/"):
@@ -628,6 +659,18 @@ class StoreHandler(BaseHTTPRequestHandler):
                     else:
                         body = rprofile.prometheus_text(
                             metrics, run=rel or d.name)
+                        # node observability samples (latest per-node
+                        # resource/skew gauges + log-event counters)
+                        # ride on the same scrape
+                        try:
+                            from . import nodeprobe as jnodeprobe
+
+                            nlines = jnodeprobe.prometheus_lines(
+                                jstore.load_nodes(d))
+                            if nlines:
+                                body += "\n".join(nlines) + "\n"
+                        except Exception:  # noqa: BLE001
+                            logger.exception("node metrics failed")
                         # atlas-level coverage samples ride on the
                         # same scrape (jepsen_tpu.coverage)
                         try:

@@ -1,0 +1,639 @@
+"""Node observability plane tests (ISSUE 9): probe parse + nodes.jsonl
+schema round-trip and rejection matrix, gap-marker honesty across a
+partition window, quarantine skip + breaker transitions + advisory
+health, clock-offset normalization of log-event timestamps, the
+log-scanner taxonomy, the merged check-offsets skew series, Perfetto
+node-track validity, anomaly excerpts naming node events, Prometheus
+exposition, and the seeded clusterless e2e with a wgl verdict carrying
+a finite clock-skew-bound."""
+
+import json
+import random
+
+import pytest
+
+from jepsen_tpu import checker as jchecker
+from jepsen_tpu import client as jclient
+from jepsen_tpu import core, nodeprobe, testing, util, web
+from jepsen_tpu import generator as gen
+from jepsen_tpu import store as jstore
+from jepsen_tpu.control.core import (Action, Remote, Session,
+                                     TransportError)
+from jepsen_tpu.control.dummy import DummyRemote
+from jepsen_tpu.history import History, op
+from jepsen_tpu.reports import explain
+from jepsen_tpu.reports import nodes as rnodes
+from jepsen_tpu.reports import trace as rtrace
+from jepsen_tpu.workloads import register as register_wl
+
+LOG = "/var/log/db.log"
+
+
+def _probe_test(nodes=("n1", "n2"), seed=7, **kw):
+    util.init_relative_time()
+    t = {"nodes": list(nodes), "ssh": {"dummy": True},
+         "remote": DummyRemote(nodeprobe.synthetic_responder(seed)),
+         "node_log_files": [LOG]}
+    t.update(kw)
+    return t
+
+
+def _ticks(test, n=5):
+    p = nodeprobe.NodeProbe(test, interval_s=0.01)
+    for _ in range(n):
+        for node in test["nodes"]:
+            p.tick(node)
+    p.stop()
+    return p
+
+
+# ---------------------------------------------------------------------------
+# Parse + schema
+# ---------------------------------------------------------------------------
+
+class TestProbeParse:
+    def test_synthetic_round_trip(self):
+        p = _ticks(_probe_test())
+        recs = p.records()
+        assert nodeprobe.validate_records(recs) == len(recs)
+        kinds = {r["kind"] for r in recs}
+        assert kinds == {"sample", "log"}
+        samples = [r for r in recs if r["kind"] == "sample"
+                   and r["node"] == "n1"]
+        # the first tick has no rates (no previous counters — never a
+        # made-up zero), later ticks do
+        assert "cpu" not in samples[0]
+        assert 0.0 <= samples[1]["cpu"]["busy"] <= 1.0
+        assert samples[1]["mem"]["total_kb"] > 0
+        assert samples[1]["net"]["rx_bytes_s"] >= 0
+        assert isinstance(samples[1]["clock_offset_s"], float)
+
+    def test_crlf_log_offsets_do_not_drift(self):
+        """CRLF logs: the \\r bytes survive the reply's line split, so
+        the byte-offset accounting stays exact and no line is ever
+        re-scanned (no duplicate events, ever-growing offsets)."""
+        content = {"text": ""}
+
+        def crlf_responder(node, action):
+            cmd = action.cmd
+            if nodeprobe.MARK not in cmd:
+                return None
+            import re as _re
+
+            out = [f"{nodeprobe.MARK} clock", "1.0"]
+            for off, path in _re.findall(r"tail -c \+(\d+) (\S+)",
+                                         cmd):
+                chunk = content["text"].encode()[int(off) - 1:]
+                out.append(f"{nodeprobe.MARK} log {path}")
+                out.append(chunk.decode() + nodeprobe.EOT)
+            return "\n".join(out)
+
+        t = _probe_test(nodes=["n1"])
+        t["remote"] = DummyRemote(crlf_responder)
+        p = nodeprobe.NodeProbe(t, interval_s=0.01)
+        content["text"] = "panic: first\r\n"
+        p.tick("n1")
+        content["text"] += "plain line\r\npanic: second\r\n"
+        p.tick("n1")
+        p.tick("n1")  # nothing new: must emit nothing
+        p.stop()
+        logs = [r for r in p.records() if r["kind"] == "log"]
+        assert [r["line"] for r in logs] == ["panic: first",
+                                            "panic: second"]
+        assert p._states["n1"].offsets[LOG] == len(
+            content["text"].encode())
+
+    def test_log_tailer_no_duplicates_across_ticks(self):
+        """Byte-offset tailing: each seeded log line is scanned once,
+        even though every tick re-probes."""
+        p = _ticks(_probe_test(), n=6)
+        logs = [r for r in p.records() if r["kind"] == "log"]
+        assert logs
+        assert len(logs) == len({(r["node"], r["line"])
+                                 for r in logs})
+        classes = {r["class"] for r in logs}
+        assert classes == {"election", "oom-kill"}
+
+    def test_bare_dummy_remote_yields_honest_no_data_gap(self):
+        """A reachable-but-mute node (the bare dummy remote's empty
+        success) is a gap, not a zeroed sample."""
+        t = _probe_test()
+        t["remote"] = DummyRemote()  # no responder: empty replies
+        p = _ticks(t, n=2)
+        recs = p.records()
+        assert recs and all(r["kind"] == "gap" for r in recs)
+        assert {r["reason"] for r in recs} == {"no-data"}
+        assert nodeprobe.validate_records(recs) == len(recs)
+
+    def test_jsonl_round_trip(self, tmp_path):
+        t = _probe_test()
+        p = nodeprobe.NodeProbe(t, interval_s=0.01)
+        p.start(tmp_path / nodeprobe.NODES_FILE)
+        # threads are running, but ticks here are deterministic too
+        for _ in range(3):
+            p.tick("n1")
+        p.stop()
+        loaded = nodeprobe.load_records(tmp_path)
+        assert loaded
+        assert nodeprobe.validate_records(loaded) == len(loaded)
+        assert loaded == json.loads(json.dumps(loaded))
+
+
+class TestSchemaRejection:
+    def _good(self):
+        return [
+            {"kind": "sample", "node": "n1", "t": 10,
+             "mem": {"total_kb": 1, "free_kb": 1, "used_frac": 0.0},
+             "clock_offset_s": 0.5},
+            {"kind": "gap", "node": "n1", "t": 20,
+             "reason": "unreachable"},
+            {"kind": "log", "node": "n1", "t": 30, "class": "oom-kill",
+             "file": LOG, "line": "x", "ts": "observed"},
+            {"kind": "breaker", "node": "n1", "t": 40,
+             "state": "open"},
+        ]
+
+    def test_good_records_pass(self):
+        assert nodeprobe.validate_records(self._good()) == 4
+
+    @pytest.mark.parametrize("mutate", [
+        lambda r: r[0].pop("node"),
+        lambda r: r[0].__setitem__("kind", "mystery"),
+        lambda r: r[0].__setitem__("t", -1),
+        lambda r: r[0].__setitem__("t", 1.5),
+        lambda r: r[0].__setitem__("cpu", {"busy": "hot"}),
+        lambda r: r[0].__setitem__("clock_offset_s", "skewed"),
+        lambda r: r[1].__setitem__("reason", "felt-like-it"),
+        lambda r: r[2].__setitem__("class", "novel-anomaly"),
+        lambda r: r[2].__setitem__("ts", "guessed"),
+        lambda r: r[2].pop("line"),
+        lambda r: r[3].__setitem__("state", "ajar"),
+        # a sample whose time regresses against its node's series
+        lambda r: r.append({"kind": "sample", "node": "n1", "t": 5}),
+    ])
+    def test_validate_rejects_bad_records(self, mutate):
+        recs = self._good()
+        mutate(recs)
+        with pytest.raises(ValueError):
+            nodeprobe.validate_records(recs)
+
+
+# ---------------------------------------------------------------------------
+# Gap honesty + quarantine + breaker + advisory
+# ---------------------------------------------------------------------------
+
+class _Cut:
+    def __init__(self):
+        self.nodes = set()
+
+
+class CuttingRemote(Remote):
+    """Wraps another remote; nodes in `cut.nodes` raise
+    TransportError on every command — a partition the probe must
+    report as gaps, never interpolate across."""
+
+    def __init__(self, inner, cut: _Cut):
+        self.inner = inner
+        self.cut = cut
+
+    def connect(self, conn_spec):
+        inner = self.inner.connect(conn_spec)
+        node = conn_spec.get("host")
+        cut = self.cut
+
+        class S(Session):
+            def execute(self, action):
+                if node in cut.nodes:
+                    raise TransportError("partitioned", node=node)
+                return inner.execute(action)
+
+            def disconnect(self):
+                inner.disconnect()
+
+        return S()
+
+
+class TestGapHonesty:
+    def test_partition_window_yields_gaps_never_interpolation(self):
+        cut = _Cut()
+        t = _probe_test(nodes=["n1"])
+        t["remote"] = CuttingRemote(t["remote"], cut)
+        p = nodeprobe.NodeProbe(t, interval_s=0.01)
+        p.tick("n1")                      # healthy
+        p.tick("n1")
+        cut.nodes.add("n1")               # partition window opens
+        p.tick("n1")
+        p.tick("n1")
+        cut.nodes.discard("n1")           # heals
+        p.tick("n1")
+        p.stop()
+        recs = p.records()
+        assert nodeprobe.validate_records(recs) == len(recs)
+        shape = [r["kind"] for r in recs if r["kind"] in
+                 ("sample", "gap")]
+        assert shape == ["sample", "sample", "gap", "gap", "sample"]
+        assert all(r.get("reason") == "unreachable"
+                   for r in recs if r["kind"] == "gap")
+        # honesty: nothing sampled inside the window — the gap records
+        # ARE the observation, no values were invented. (Log events
+        # are excluded: their normalized node-clock times may precede
+        # the tick that observed them.)
+        ts = [r["t"] for r in recs if r["kind"] in ("sample", "gap")]
+        assert ts == sorted(ts)
+
+    def test_quarantined_node_skipped_without_transport_traffic(self):
+        from jepsen_tpu.control.health import HealthRegistry
+
+        hr = HealthRegistry(threshold=1, cooldown_s=3600)
+        seen = []
+
+        def counting(node, action):
+            seen.append((node, action.cmd))
+            return None
+
+        t = _probe_test(nodes=["n1"])
+        t["remote"] = DummyRemote(counting)
+        t["health"] = hr
+        hr.breaker("n1").failure()        # circuit opens
+        assert hr.breaker("n1").is_open
+        p = nodeprobe.NodeProbe(t, interval_s=0.01)
+        p.tick("n1")
+        p.stop()
+        recs = p.records()
+        gaps = [r for r in recs if r["kind"] == "gap"]
+        assert gaps and gaps[0]["reason"] == "quarantined"
+        assert not seen                   # zero commands issued
+        # the breaker transition was recorded for the web badge
+        assert [r["state"] for r in recs
+                if r["kind"] == "breaker"] == ["open"]
+
+    def test_breaker_states_and_half_open_counter(self):
+        from jepsen_tpu import telemetry
+        from jepsen_tpu.control.health import CircuitBreaker
+
+        telemetry.reset()
+        b = CircuitBreaker("n1", threshold=1, cooldown_s=0.0)
+        assert b.state() == "closed"
+        b.failure()
+        # cooldown 0: immediately eligible for a probe
+        assert b.state() == "half-open"
+        assert b.admit() is True          # granted as THE probe
+        assert telemetry.get().counters()[
+            "control.quarantine.half-open"] == 1
+        b.success()
+        assert b.state() == "closed"
+
+    def test_advisory_warns_never_trips(self):
+        from jepsen_tpu.control.health import HealthRegistry
+
+        hr = HealthRegistry()
+        t = _probe_test(nodes=["n1"], health=hr)
+        p = nodeprobe.NodeProbe(t, interval_s=0.01)
+        st = p._states["n1"]
+        sample = {"kind": "sample", "node": "n1", "t": 1,
+                  "mem": {"total_kb": 1000, "free_kb": 10,
+                          "used_frac": 0.99},
+                  "cpu": {"busy": 0.999}}
+        p._advise("n1", st, sample)
+        p._advise("n1", st, sample)       # repeated: warned once
+        adv = hr.advisories()
+        assert set(adv["n1"]) == {"low-memory", "cpu-saturated"}
+        # advisory only: no breaker exists, nothing quarantined
+        assert hr.quarantined() == []
+        assert hr.states().get("n1", "closed") == "closed"
+
+
+# ---------------------------------------------------------------------------
+# Log taxonomy + clock normalization
+# ---------------------------------------------------------------------------
+
+class TestLogTaxonomy:
+    @pytest.mark.parametrize("line,cls", [
+        ("panic: runtime error: index out of range", "panic-assert"),
+        ("Assertion failed: (x > 0), function f", "panic-assert"),
+        ("Out of memory: Killed process 1234 (db)", "oom-kill"),
+        ("raft: node 3 elected leader at term 7", "election"),
+        ("stepping down as leader", "election"),
+        ("detected data corruption in block 9", "corruption"),
+        ("checksum mismatch on sstable 12", "corruption"),
+        ("Starting server, version 5.1", "restart"),
+        ("received signal SIGTERM, shutting down", "restart"),
+        ("slow query: select * from t", None),
+        ("", None),
+    ])
+    def test_classify(self, line, cls):
+        assert nodeprobe.classify_line(line) == cls
+
+    def test_first_match_wins(self):
+        # a panic that mentions the leader is a panic
+        assert nodeprobe.classify_line(
+            "panic: leader election raced") == "panic-assert"
+
+
+class TestClockNormalization:
+    def test_parsed_timestamp_normalized_by_measured_offset(self):
+        """A log line stamped by a clock 300s in the future lands at
+        its TRUE run-relative time once the measured offset is
+        subtracted."""
+        import calendar
+
+        util.init_relative_time()
+        p = nodeprobe.NodeProbe(_probe_test(nodes=["n1"]))
+        p.origin_epoch = calendar.timegm((2026, 8, 3, 12, 0, 0))
+        skew = 300.0
+        # the node thinks it's 12:00:10 + 5m; really 12:00:10
+        line = "2026-08-03 12:05:10.500 W | Out of memory: Killed"
+        rec = p._log_event("n1", LOG, line, "oom-kill", t=999,
+                           clock_offset_s=skew)
+        assert rec["ts"] == "parsed"
+        assert rec["t"] == int(10.5 * 1e9)
+        assert rec["t_node_s"] == pytest.approx(
+            p.origin_epoch + 310.5, abs=0.01)
+
+    def test_unparseable_timestamp_stamped_at_observation(self):
+        p = nodeprobe.NodeProbe(_probe_test(nodes=["n1"]))
+        rec = p._log_event("n1", LOG, "panic: no timestamp here",
+                           "panic-assert", t=1234,
+                           clock_offset_s=50.0)
+        assert rec["ts"] == "observed" and rec["t"] == 1234
+
+    def test_pre_run_timestamp_clamps_not_negative(self):
+        p = nodeprobe.NodeProbe(_probe_test(nodes=["n1"]))
+        p.origin_epoch = 2e9
+        rec = p._log_event("n1", LOG, "[1000000000.5] panic: old",
+                           "panic-assert", t=7, clock_offset_s=0.0)
+        assert rec["t"] == 0 and rec["ts"] == "parsed"
+
+
+# ---------------------------------------------------------------------------
+# Skew series: probe + check-offsets merge
+# ---------------------------------------------------------------------------
+
+def _offsets_history():
+    return History([
+        op(type="info", process="nemesis", f="check-offsets",
+           value=None, time=100),
+        op(type="info", process="nemesis", f="check-offsets",
+           value=None, time=200,
+           **{"clock-offsets": {"n1": 0.75, "n2": -0.1}}),
+    ])
+
+
+class TestSkewSeries:
+    def test_check_offsets_merge_into_series(self):
+        recs = [{"kind": "sample", "node": "n1", "t": 500,
+                 "clock_offset_s": 0.2}]
+        series = nodeprobe.clock_series(recs, _offsets_history())
+        assert series["n1"] == [[200, 0.75], [500, 0.2]]
+        assert series["n2"] == [[200, -0.1]]
+
+    def test_bound_is_worst_absolute_offset(self):
+        recs = [{"kind": "sample", "node": "n1", "t": 1,
+                 "clock_offset_s": -0.3}]
+        assert nodeprobe.clock_skew_bound(
+            recs, _offsets_history()) == 0.75
+        assert nodeprobe.clock_skew_bound(recs, None) == 0.3
+        # an unmeasured run claims NO bound, not a zero one
+        assert nodeprobe.clock_skew_bound([], History([])) is None
+
+    def test_stamp_hits_realtime_verdicts_only(self):
+        results = {
+            "valid?": True,
+            "linear": {"valid?": True,
+                       "anomaly-classes": {"nonlinearizable": "clean"}},
+            "elle": {"valid?": True,
+                     "anomaly-classes": {"G0": "clean",
+                                         "G1a": "clean"}},
+            "stats": {"valid?": True, "count": 3},
+        }
+        n = nodeprobe.stamp_results(results, 0.5)
+        assert n == 2
+        assert results["linear"]["clock-skew-bound"] == 0.5
+        assert results["elle"]["clock-skew-bound"] == 0.5
+        assert "clock-skew-bound" not in results["stats"]
+
+    def test_clock_plot_merges_probe_series(self, tmp_path):
+        from jepsen_tpu.reports import clock as rclock
+
+        t = {"store_dir": str(tmp_path)}
+        with open(tmp_path / nodeprobe.NODES_FILE, "w") as f:
+            f.write(json.dumps({"kind": "sample", "node": "n1",
+                                "t": int(3e9),
+                                "clock_offset_s": 0.4}) + "\n")
+        hist = _offsets_history()
+        merged = rclock.merge_nodeprobe(
+            rclock.history_to_datasets(hist), t)
+        pts = merged["n1"]
+        assert [3.0, 0.4] in pts
+        assert any(v == 0.75 for _t, v in pts)
+
+
+# ---------------------------------------------------------------------------
+# Perfetto node tracks
+# ---------------------------------------------------------------------------
+
+class TestPerfetto:
+    def test_node_tracks_validate(self):
+        p = _ticks(_probe_test(), n=5)
+        recs = p.records()
+        recs.append({"kind": "gap", "node": "n1",
+                     "t": util.relative_time_nanos(),
+                     "reason": "unreachable"})
+        doc = rtrace.chrome_trace({}, History([]), [], noderecs=recs)
+        assert rtrace.validate_chrome_trace(doc) > 0
+        procs = {e["args"]["name"] for e in doc["traceEvents"]
+                 if e.get("ph") == "M"
+                 and e["name"] == "process_name"}
+        assert {"node n1", "node n2"} <= procs
+        counters = {e["name"] for e in doc["traceEvents"]
+                    if e.get("ph") == "C"}
+        assert {"cpu_busy", "mem_used_frac",
+                "clock_offset_ms"} <= counters
+        instants = {e["name"] for e in doc["traceEvents"]
+                    if e.get("ph") == "i"
+                    and e.get("cat", "").startswith("node")}
+        assert "gap:unreachable" in instants
+        assert any(n.startswith("log:") for n in instants)
+
+    def test_check_offsets_render_without_probe_samples(self):
+        """Satellite fix: a run with only check-offsets history still
+        gets a per-node clock-offset counter track."""
+        doc = rtrace.chrome_trace({}, _offsets_history(), [],
+                                  noderecs=[])
+        assert rtrace.validate_chrome_trace(doc) > 0
+        cs = [e for e in doc["traceEvents"] if e.get("ph") == "C"]
+        assert {e["args"]["clock_offset_ms"] for e in cs} == \
+            {750.0, -100.0}
+
+    def test_counter_event_with_bad_args_rejected(self):
+        doc = {"traceEvents": [
+            {"ph": "M", "name": "process_name", "pid": 1, "tid": 0,
+             "args": {"name": "x"}},
+            {"ph": "M", "name": "thread_name", "pid": 1, "tid": 1,
+             "args": {"name": "c"}},
+            {"ph": "C", "name": "c", "pid": 1, "tid": 1, "ts": 1,
+             "args": {"c": "fast"}}]}
+        with pytest.raises(ValueError):
+            rtrace.validate_chrome_trace(doc)
+
+
+# ---------------------------------------------------------------------------
+# Excerpts + renderers + prometheus
+# ---------------------------------------------------------------------------
+
+class TestNodeContext:
+    def _noderecs(self):
+        return [
+            {"kind": "log", "node": "n1", "t": int(1.5e9),
+             "class": "election", "file": LOG,
+             "line": "raft: became leader", "ts": "parsed"},
+            {"kind": "gap", "node": "n2", "t": int(2e9),
+             "reason": "unreachable"},
+            {"kind": "log", "node": "n1", "t": int(500e9),
+             "class": "restart", "file": LOG,
+             "line": "way outside the window", "ts": "observed"},
+        ]
+
+    def test_window_filter_and_format(self):
+        lines = explain.node_context_lines(self._noderecs(),
+                                           int(1e9), int(3e9))
+        joined = "\n".join(lines)
+        assert "election" in joined and "became leader" in joined
+        assert "probe gap: unreachable" in joined
+        assert "way outside" not in joined
+
+    def test_excerpt_names_node_events(self, tmp_path):
+        from jepsen_tpu import tracing
+
+        tr = tracing.Tracer(enabled=True)
+        from jepsen_tpu.history import Op
+
+        for i in (0, 2):
+            o = Op(index=i, time=i, type="invoke", process=0, f="txn",
+                   value=None)
+            with tr.op_span(o):
+                pass
+        result = {"anomalies": {"G1a": [{"op-indices": [0, 2]}]}}
+        paths = explain.write_trace_excerpts(
+            tmp_path, result, optrace=tr.records(),
+            noderecs=[{"kind": "log", "node": "n1", "t": 1,
+                       "class": "oom-kill", "file": LOG,
+                       "line": "Out of memory: Killed process 42",
+                       "ts": "parsed"}])
+        body = open(paths[0]).read()
+        assert "node events in the op window" in body
+        assert "oom-kill" in body and "Killed process 42" in body
+
+
+class TestRenderers:
+    def test_nodes_text_table(self):
+        p = _ticks(_probe_test(), n=5)
+        txt = rnodes.nodes_text(p.records())
+        assert "n1" in txt and "n2" in txt
+        assert "clock-skew-bound" in txt
+        assert "election" in txt
+
+    def test_lanes_html_marks_faults_gaps_and_events(self):
+        p = _ticks(_probe_test(), n=4)
+        recs = p.records()
+        recs.append({"kind": "gap", "node": "n1",
+                     "t": util.relative_time_nanos(),
+                     "reason": "quarantined"})
+        t_max = max(r["t"] for r in recs)
+        html = rnodes.lanes_html(
+            recs, faults=[{"kind": "partition",
+                           "windows": [[0, t_max // 2]]}])
+        assert "<h2>nodes</h2>" in html and "partition" in html
+        assert "gap: quarantined" in html
+        assert "clock-skew-bound" in html
+
+    def test_prometheus_lines_scrape_parse(self):
+        from jepsen_tpu.reports.profile import \
+            validate_prometheus_text
+
+        p = _ticks(_probe_test(), n=4)
+        lines = nodeprobe.prometheus_lines(p.records())
+        assert validate_prometheus_text("\n".join(lines) + "\n") > 0
+        joined = "\n".join(lines)
+        assert "jepsen_tpu_node_cpu_busy" in joined
+        assert "jepsen_tpu_node_log_events" in joined
+
+
+# ---------------------------------------------------------------------------
+# End-to-end: seeded clusterless run (the ISSUE-9 acceptance path)
+# ---------------------------------------------------------------------------
+
+class TestEndToEnd:
+    def _run(self, tmp_path, corrupt=False):
+        from jepsen_tpu.checker import models
+
+        state = testing.AtomState()
+        inner = testing.AtomClient(state)
+        reads = [0]
+
+        class MaybeCorrupting(jclient.Client):
+            def open(self, test, node):
+                return self
+
+            def invoke(self, test, op_):
+                out = inner.invoke(test, op_)
+                if corrupt and op_.f == "read" and out.type == "ok":
+                    reads[0] += 1
+                    if reads[0] == 5:
+                        return out.copy(value=999)
+                return out
+
+        rng = random.Random(7)
+        t = testing.noop_test()
+        t.update(
+            name="nodeplane-e2e", store_base=str(tmp_path),
+            nodes=["n1", "n2"], concurrency=4,
+            remote=DummyRemote(nodeprobe.synthetic_responder(11)),
+            node_log_files=[LOG],
+            client=MaybeCorrupting(),
+            checker=jchecker.compose({
+                "stats": jchecker.stats(),
+                "linear": jchecker.linearizable(
+                    {"model": models.cas_register(),
+                     "algorithm": "wgl"})}),
+            generator=gen.clients(gen.stagger(0.01, gen.limit(
+                30, lambda: register_wl.cas_op_mix(rng,
+                                                   n_values=3)))))
+        t["nodeprobe?"] = True
+        t["nodeprobe_interval_s"] = 0.02
+        t["trace?"] = True
+        return core.run(t)
+
+    def test_clean_run_stamps_finite_skew_bound(self, tmp_path):
+        test = self._run(tmp_path)
+        d = jstore.path(test)
+        recs = jstore.load_nodes(d)
+        # schema-valid nodes.jsonl with >= 1 tagged log event
+        assert nodeprobe.validate_records(recs) == len(recs)
+        assert any(r["kind"] == "log" for r in recs)
+        res = test["results"]
+        # the wgl-realtime verdict carries a FINITE clock-skew-bound
+        bound = res["linear"].get("clock-skew-bound")
+        assert isinstance(bound, float) and 0 < bound < 10
+        assert res.get("clock-skew-bound") == bound
+        # Perfetto export with node tracks validates
+        doc = json.load(open(rtrace.write_trace(d)))
+        assert rtrace.validate_chrome_trace(doc) > 0
+        procs = {e["args"]["name"] for e in doc["traceEvents"]
+                 if e.get("ph") == "M"
+                 and e["name"] == "process_name"}
+        assert {"node n1", "node n2"} <= procs
+        # the web run page renders the lanes
+        rel = f"nodeplane-e2e/{d.name}"
+        html = web.dir_html(rel + "/", d)
+        assert "<h2>nodes</h2>" in html
+
+    def test_seeded_anomaly_excerpt_names_node_event(self, tmp_path):
+        test = self._run(tmp_path, corrupt=True)
+        res = test["results"]["linear"]
+        assert res["valid?"] is False
+        assert res.get("clock-skew-bound", 0) > 0
+        body = open(res["trace-excerpt"]).read()
+        # the anomaly excerpt names the node events in its op window
+        assert "node events in the op window" in body
+        assert "election" in body or "oom-kill" in body

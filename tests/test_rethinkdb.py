@@ -1,0 +1,239 @@
+"""RethinkDB suite tests: registry, DB command emission via the dummy
+remote, query-reply classification, and clusterless end-to-end
+document-CAS runs (mirrors aphyr/jepsen rethinkdb document.clj)."""
+
+import threading
+
+from jepsen_tpu import control, core, suites, testing
+from jepsen_tpu import generator as gen
+from jepsen_tpu.control.core import Action
+from jepsen_tpu.control.dummy import DummyRemote
+from jepsen_tpu.history import Op
+from jepsen_tpu.suites import rethinkdb as rdb
+
+
+class TestRegistry:
+    def test_rethinkdb_registered(self):
+        assert "rethinkdb" in suites.SUITES
+        assert suites.load("rethinkdb") is rdb
+
+
+class TestDB:
+    def test_setup_commands(self):
+        remote = DummyRemote()
+        test = testing.noop_test()
+        test.update(nodes=["n1", "n2", "n3"], remote=remote,
+                    sessions={n: remote.connect({"host": n})
+                              for n in ["n1", "n2", "n3"]})
+        db = rdb.RethinkDB()
+        with control.with_session(test, "n2"):
+            db.setup(test, "n2")
+        got = " ; ".join(a.cmd for a in test["sessions"]["n2"].log
+                         if isinstance(a, Action))
+        assert "rethinkdb" in got
+        assert "service rethinkdb restart" in got
+        # the uploaded query helper, and the conf written via the
+        # control plane
+        assert rdb.QUERY in got
+        assert rdb.CONF in got
+
+    def test_conf_joins_every_other_node(self):
+        test = {"nodes": ["n1", "n2", "n3"]}
+        body = rdb.conf_body(test, "n2")
+        assert f"join=n1:{rdb.CLUSTER_PORT}" in body
+        assert f"join=n3:{rdb.CLUSTER_PORT}" in body
+        assert f"join=n2:{rdb.CLUSTER_PORT}" not in body
+        assert "server-name=n2" in body
+
+    def test_setup_primary_passes_acks_and_replicas(self):
+        remote = DummyRemote()
+        test = testing.noop_test()
+        test.update(nodes=["n1", "n2", "n3"], remote=remote,
+                    sessions={n: remote.connect({"host": n})
+                              for n in ["n1", "n2", "n3"]})
+        db = rdb.RethinkDB(write_acks="single", read_mode="single")
+        db.setup_primary(test, "n1")
+        got = " ; ".join(a.cmd for a in test["sessions"]["n1"].log
+                         if isinstance(a, Action))
+        assert "setup single single 3" in got
+
+
+class FakeRethink:
+    """The single document, speaking the query helper's reply
+    protocol, atomically under a lock."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.val = None
+
+    def run(self, *args):
+        op = args[0]
+        with self.lock:
+            if op == "read":
+                return "NONE" if self.val is None \
+                    else f"VAL {self.val}"
+            if op == "write":
+                self.val = int(args[3])
+                return "OK"
+            if op == "cas":
+                old, new = int(args[3]), int(args[4])
+                if self.val == old:
+                    self.val = new
+                    return "CAS 1"
+                return "CAS 0"
+            raise AssertionError(f"unexpected {args}")
+
+
+class FakeCliFactory:
+    def __init__(self, state=None):
+        self.state = state or FakeRethink()
+
+    def __call__(self, test, node, timeout=10.0):
+        factory = self
+
+        class _C:
+            def run(self, *args):
+                return factory.state.run(*args)
+
+            def close(self):
+                pass
+
+        return _C()
+
+
+def run_register(opts, factory):
+    w = rdb.register_workload(opts)
+    w["client"].cli_factory = factory
+    test = testing.noop_test()
+    test.update(nodes=["n1", "n2"],
+                concurrency=opts.get("concurrency", 4),
+                client=w["client"], checker=w["checker"],
+                generator=gen.clients(
+                    gen.stagger(0.0004, w["generator"])))
+    return core.run(test)
+
+
+class TestEndToEnd:
+    def test_register_valid(self):
+        test = run_register({"ops": 150, "seed": 3},
+                            FakeCliFactory())
+        assert test["results"]["valid?"] is True
+        # the one class this checker decides is explicitly tagged
+        assert test["results"]["anomaly-classes"][
+            "nonlinearizable"] == "clean"
+
+    def test_register_detects_stale_read(self):
+        class Stale(FakeRethink):
+            def __init__(self):
+                super().__init__()
+                self.reads = 0
+
+            def run(self, *args):
+                if args[0] == "read":
+                    self.reads += 1
+                    if self.reads >= 20:
+                        return "VAL 99"  # never written
+                return super().run(*args)
+
+        test = run_register({"ops": 150, "seed": 3},
+                            FakeCliFactory(Stale()))
+        assert test["results"]["valid?"] is False
+
+
+class TestClientErrors:
+    def _client(self, factory):
+        return rdb.RethinkCasClient(factory).open({}, "n1")
+
+    def test_cas_precondition_failure_is_definite_fail(self):
+        c = self._client(FakeCliFactory())
+        op = Op(index=0, time=0, type="invoke", process=0, f="cas",
+                value=[1, 2])
+        assert c.invoke({}, op).type == "fail"
+
+    def test_opaque_cas_error_reply_is_indeterminate(self):
+        """The query helper routes non-abort update errors as ERR (a
+        cas whose acks failed MAY have applied) — the client must
+        classify them info, never a definite CAS-0 fail."""
+        class AckError:
+            def __call__(self, test, node, timeout=10.0):
+                class _C:
+                    def run(self, *args):
+                        return ("ERR Write acks not satisfied: "
+                                "1 of 2 acks received")
+
+                    def close(self):
+                        pass
+
+                return _C()
+
+        c = self._client(AckError())
+        op = Op(index=0, time=0, type="invoke", process=0, f="cas",
+                value=[1, 2])
+        assert c.invoke({}, op).type == "info"
+
+    def test_query_script_cas_error_branches(self):
+        """The uploaded helper's source keeps the abort/indeterminate
+        split: only OUR precondition abort prints CAS 0."""
+        assert '"abort" in err' in rdb.QUERY_SCRIPT
+        assert 'print("ERR %s" % (err or "cas error"))' in \
+            rdb.QUERY_SCRIPT
+
+    def test_err_reply_lost_primary_is_definite_fail_for_write(self):
+        class Lost:
+            def __call__(self, test, node, timeout=10.0):
+                class _C:
+                    def run(self, *args):
+                        return ("ERR Cannot perform write: lost "
+                                "contact with primary replica")
+
+                    def close(self):
+                        pass
+
+                return _C()
+
+        c = self._client(Lost())
+        op = Op(index=0, time=0, type="invoke", process=0, f="write",
+                value=3)
+        assert c.invoke({}, op).type == "fail"
+
+    def test_opaque_transport_error_on_write_is_indeterminate(self):
+        class Dying:
+            def __call__(self, test, node, timeout=10.0):
+                class _C:
+                    def run(self, *args):
+                        from jepsen_tpu.control.core import RemoteError
+
+                        raise RemoteError("broken pipe", exit=1,
+                                          out="", err="broken pipe",
+                                          cmd="write", node=node)
+
+                    def close(self):
+                        pass
+
+                return _C()
+
+        c = self._client(Dying())
+        op = Op(index=0, time=0, type="invoke", process=0, f="write",
+                value=3)
+        assert c.invoke({}, op).type == "info"
+
+    def test_any_error_on_read_is_definite_fail(self):
+        class Dying:
+            def __call__(self, test, node, timeout=10.0):
+                class _C:
+                    def run(self, *args):
+                        from jepsen_tpu.control.core import RemoteError
+
+                        raise RemoteError("timeout", exit=1, out="",
+                                          err="timed out", cmd="read",
+                                          node=node)
+
+                    def close(self):
+                        pass
+
+                return _C()
+
+        c = self._client(Dying())
+        op = Op(index=0, time=0, type="invoke", process=0, f="read",
+                value=None)
+        assert c.invoke({}, op).type == "fail"
