@@ -305,6 +305,7 @@ def main(argv=None) -> None:
     commands.update(cli.profile_cmd())
     commands.update(cli.nodes_cmd())
     commands.update(cli.trace_cmd())
+    commands.update(cli.certify_cmd())
     commands.update(cli.analyze_cmd(make_test))
     commands.update(cli.coverage_cmd(list(workloads.REGISTRY)))
     cli.run_cli(commands, argv)

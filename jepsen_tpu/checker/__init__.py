@@ -319,6 +319,10 @@ class Linearizable(Checker):
         assert self.model is not None, \
             "the linearizable checker requires a model"
         self.algorithm = opts.get("algorithm", "tpu")
+        # checker-driven verdicts carry a machine-checkable proof by
+        # default (jepsen_tpu.tpu.certify); raw wgl.analysis calls
+        # (bench kernels) don't pay for extraction
+        self.certify = bool(opts.get("certify", True))
 
     @staticmethod
     def _trim(a: dict) -> dict:
@@ -339,7 +343,8 @@ class Linearizable(Checker):
             ckpt_dir = Path(test["store_dir"]) / "checker-frontier"
         out = self._trim(wgl.analysis(self.model, hist,
                                       algorithm=self.algorithm,
-                                      checkpoint_dir=ckpt_dir))
+                                      checkpoint_dir=ckpt_dir,
+                                      certify=self.certify))
         return self._explain(test, out)
 
     @staticmethod
@@ -386,10 +391,12 @@ class Linearizable(Checker):
         if self.algorithm != "tpu":
             return [self._explain(test, self._trim(
                         wgl.analysis(self.model, hh,
-                                     algorithm=self.algorithm)))
+                                     algorithm=self.algorithm,
+                                     certify=self.certify)))
                     for hh in hists]
         return [self._explain(test, self._trim(a)) for a in
-                wgl.analysis_batch(self.model, hists)]
+                wgl.analysis_batch(self.model, hists,
+                                   certify=self.certify)]
 
 
 def linearizable(opts: dict) -> Checker:

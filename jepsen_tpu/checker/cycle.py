@@ -41,8 +41,11 @@ def _with_artifacts(test, result: dict) -> dict:
 
 def append_checker(opts: dict | None = None) -> Checker:
     """Checks list-append histories via the elle-equivalent engine
-    (append.clj:11-27)."""
+    (append.clj:11-27). Checker-driven verdicts carry a verdict
+    certificate by default (jepsen_tpu.tpu.certify; pass
+    {'certify': False} to skip the proof)."""
     o = dict(opts or {})
+    o.setdefault("certify", True)
 
     def run(test, hist, copts):
         return _with_artifacts(test, elle.check_list_append(hist, o))
@@ -51,8 +54,10 @@ def append_checker(opts: dict | None = None) -> Checker:
 
 
 def wr_checker(opts: dict | None = None) -> Checker:
-    """Checks rw-register histories (wr.clj:10-25)."""
+    """Checks rw-register histories (wr.clj:10-25). Verdicts carry a
+    certificate by default, like append_checker."""
     o = dict(opts or {})
+    o.setdefault("certify", True)
 
     def run(test, hist, copts):
         return _with_artifacts(test, elle.check_rw_register(hist, o))

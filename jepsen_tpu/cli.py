@@ -390,6 +390,80 @@ def nodes_cmd() -> dict:
     return {"nodes": {"parser_fn": build, "run": run}}
 
 
+def certify_cmd() -> dict:
+    """A 'certify' subcommand: independently re-validates a stored
+    run's verdict certificates against its recovered history (valid →
+    replayable linearization / serialization order; invalid →
+    confirmed witness or justified cycle; jepsen_tpu.tpu.certify,
+    doc/observability.md). --print pretty-prints each certificate.
+    Exit: 0 = every present certificate validates, 1 = at least one
+    proof failed, 2 = the run carries no certificates."""
+    def build(p):
+        _store_run_opts(p)
+        p.add_argument("--print", action="store_true", dest="print_",
+                       help="Pretty-print each certificate instead "
+                            "of just validating it.")
+        return p
+
+    def run(options):
+        import json as _json
+
+        from . import store as jstore
+        from .store import format as fmt
+        from .tpu import certify as jcertify
+
+        d = _resolve_stored_run(options)
+        if d is None:
+            print(f"no such stored test: {options.test}")
+            return 254
+        try:
+            results = jstore.load_results(d)
+        except (OSError, ValueError):
+            results = None
+        if not isinstance(results, dict):
+            print(f"no results.json under {d} (crashed run? try "
+                  "`analyze --resume` first)")
+            return 2
+        hist = fmt.read_history(d / "history.jlog")
+        digest = jcertify.history_digest(hist)
+        print(f"# {d.resolve()}\n")
+        rows = []
+        errors = 0
+        for path, res in jcertify.iter_certificates(results):
+            cert = res["certificate"]
+            if isinstance(cert.get("absent"), str) \
+                    and cert["absent"]:
+                status = f"absent ({cert['absent']})"
+            else:
+                # a malformed/unknown-version certificate is itself a
+                # diagnosis, not a crash: validate() schema-checks
+                # first, so it lands in the error column and exit 1
+                try:
+                    jcertify.validate(hist, cert, digest=digest)
+                    status = "certified"
+                except jcertify.CertificateError as e:
+                    status = f"ERROR: {e}"
+                    errors += 1
+            kind = cert.get("kind", "-")
+            verdict = cert.get("verdict", "-")
+            rows.append((path, kind, verdict, status))
+            if options.print_:
+                print(f"## {path}")
+                print(_json.dumps(cert, indent=1, default=repr))
+                print()
+        if not rows:
+            print("(no certificates — the run predates verdict "
+                  "certification, or every checker skipped it)")
+            return 2
+        w = max(len(p) for p, *_r in rows)
+        for path, kind, verdict, status in rows:
+            print(f"{path.ljust(w)}  {kind:<5} {verdict:<8} {status}")
+        print(f"\n{len(rows)} certificate(s), {errors} error(s)")
+        return 1 if errors else 0
+
+    return {"certify": {"parser_fn": build, "run": run}}
+
+
 def trace_cmd() -> dict:
     """A 'trace' subcommand: exports a stored run as Chrome-trace JSON
     (trace.json) openable in ui.perfetto.dev — telemetry spans, op

@@ -367,6 +367,24 @@ def analyze(test: dict, store_ctx=None, extra_opts: dict | None = None
     # per-checker timings + phase/kernel counters ride in the results
     # (and therefore results.json) next to the verdict they explain
     if isinstance(test.get("results"), dict):
+        # verdict certificates: every wgl/elle result carrying a proof
+        # is independently re-validated against the raw history and
+        # stamped `certified` / `certificate-error` — live here, and
+        # offline too (analyze --resume re-enters this path), so a
+        # device-kernel regression fails by proof, not by luck
+        # (jepsen_tpu.tpu.certify, doc/observability.md)
+        try:
+            from .tpu import certify as jcertify
+
+            counts = jcertify.stamp_results(test["results"],
+                                            test.get("history") or [])
+            if any(counts.values()):
+                logger.info(
+                    "certificates: %d validated, %d failed, %d absent",
+                    counts["certified"], counts["errors"],
+                    counts["absent"])
+        except Exception:  # noqa: BLE001 — stamping is best-effort
+            logger.exception("certificate validation failed")
         test["results"]["telemetry"] = telemetry.get().summary()
         # the online watchdog's violations ride alongside too —
         # informational only, never folded into the checkers' valid?

@@ -308,6 +308,19 @@ def anomaly_outcomes(results, checker: str = "",
                     idxs = _class_indices(res, cls)
                     if idxs:
                         cur["op-indices"] = idxs
+                    # where in the history the anomaly localized
+                    # (the wgl/elle search explorer's witness
+                    # percentile): the earliest-localization signal
+                    # `coverage --suggest` and ROADMAP-3's early-exit
+                    # rank configs by
+                    s = res.get("search")
+                    frac = (s or {}).get("witness-position") \
+                        if isinstance(s, dict) else None
+                    if isinstance(frac, (int, float)):
+                        prev = cur.get("witness-frac")
+                        cur["witness-frac"] = (
+                            float(frac) if prev is None
+                            else min(prev, float(frac)))
         for k, v in res.items():
             if isinstance(v, dict) and k != "anomalies":
                 walk(v, f"{path}/{k}" if path else str(k), depth + 1)
@@ -454,6 +467,10 @@ def validate_record(rec) -> int:
                 isinstance(idxs, list)
                 and all(isinstance(x, int) for x in idxs)):
             raise ValueError(f"anomaly {i}: bad op-indices: {a!r}")
+        frac = a.get("witness-frac")
+        if frac is not None and not (
+                isinstance(frac, (int, float)) and 0 <= frac <= 1):
+            raise ValueError(f"anomaly {i}: bad witness-frac: {a!r}")
         n += 1
     return n
 
@@ -509,6 +526,13 @@ def atlas_entry(rec: dict) -> dict:
                       for a in rec["anomalies"]},
         "valid": rec.get("valid"),
     }
+    fracs = {a["class"]: a["witness-frac"] for a in rec["anomalies"]
+             if isinstance(a.get("witness-frac"), (int, float))}
+    if fracs:
+        # witness-position percentiles per witnessed class (not part
+        # of the digest view: they're a deterministic function of the
+        # same results the digested outcomes come from)
+        entry["witness-frac"] = fracs
     entry["digest"] = _digest(entry)
     return entry
 
@@ -615,6 +639,12 @@ def validate_atlas(entries) -> int:
                 v in OUTCOMES for v in e["anomalies"].values()):
             raise ValueError(
                 f"entry {i}: bad anomalies {e['anomalies']!r}")
+        wf = e.get("witness-frac")
+        if wf is not None and (not isinstance(wf, dict) or not all(
+                isinstance(v, (int, float)) and 0 <= v <= 1
+                for v in wf.values())):
+            raise ValueError(
+                f"entry {i}: bad witness-frac {wf!r}")
         n += 1
     return n
 
@@ -630,6 +660,7 @@ def aggregate(entries: Iterable[dict]) -> dict[tuple, dict]:
         kinds = sorted(e.get("faults") or {}) or ["none"]
         wl = str(e.get("workload") or "unknown")
         ts = e.get("ts") or 0
+        fracs = e.get("witness-frac") or {}
         for kind in kinds:
             for cls, out in sorted((e.get("anomalies") or {}).items()):
                 key = (kind, wl, cls)
@@ -638,13 +669,22 @@ def aggregate(entries: Iterable[dict]) -> dict[tuple, dict]:
                     c = cells[key] = {
                         "runs": 0, "witnessed": 0, "clean": 0,
                         "unknown": 0, "first-seen": ts,
-                        "last-seen": ts, "witnesses": []}
+                        "last-seen": ts, "witnesses": [],
+                        "earliest-witness-frac": None}
                 c["runs"] += 1
                 c[out if out in OUTCOMES else "unknown"] += 1
                 c["first-seen"] = min(c["first-seen"], ts)
                 c["last-seen"] = max(c["last-seen"], ts)
                 if out == "witnessed" and len(c["witnesses"]) < 16:
                     c["witnesses"].append(str(e.get("run")))
+                # how early the anomaly localizes in this cell — the
+                # config-ranking signal for early-exit work
+                frac = fracs.get(cls)
+                if isinstance(frac, (int, float)):
+                    prev = c["earliest-witness-frac"]
+                    c["earliest-witness-frac"] = (
+                        float(frac) if prev is None
+                        else min(prev, float(frac)))
     return cells
 
 
@@ -794,8 +834,12 @@ def coverage_text(cells: dict[tuple, dict],
             runs = ", ".join(c["witnesses"][:3])
             more = (f" (+{len(c['witnesses']) - 3} more)"
                     if len(c["witnesses"]) > 3 else "")
+            frac = c.get("earliest-witness-frac")
+            at = (f" (earliest witness at {frac * 100:.0f}% of the "
+                  "history)" if isinstance(frac, (int, float))
+                  else "")
             lines.append(f"  {k} × {w} × {a}: {c['witnessed']}/"
-                         f"{c['runs']} runs — {runs}{more}")
+                         f"{c['runs']} runs — {runs}{more}{at}")
         lines.append("")
     gs = gaps(cells, all_workloads)
     lines.append(f"# Gaps: {len(gs)} fault × workload cells never "

@@ -266,6 +266,33 @@ class IndependentChecker:
                 lambda k: chk.check_safe(self.inner, test, subs[k], opts),
                 keys, limit=8)
         by_key = dict(zip(keys, results))
+        # per-key verdict certificates reference the ORIGINAL history's
+        # op indices (subhistories keep them), but their values are
+        # wrapped (key, v) tuples there and their digest covers only
+        # the subhistory — stamp each certificate with its key (so the
+        # validator filters + unwraps during replay) and re-anchor the
+        # digest to the whole history the validator will be handed
+        # (jepsen_tpu.tpu.certify)
+        full_digest = None
+        for k, r in by_key.items():
+            cert = (r or {}).get("certificate") \
+                if isinstance(r, dict) else None
+            if isinstance(cert, dict) and "absent" not in cert:
+                from .tpu import certify as jcertify
+
+                try:
+                    import json as _json
+
+                    _json.dumps(k)
+                except (TypeError, ValueError):
+                    r["certificate"] = {"v": cert.get("v", 1),
+                                        "absent": "independent key "
+                                        "is not JSON-serializable"}
+                    continue
+                if full_digest is None:
+                    full_digest = jcertify.history_digest(hist)
+                cert["key"] = jcertify._jv(k)
+                cert["history"] = full_digest
         failures = [k for k, r in by_key.items()
                     if (r or {}).get("valid?") is False]
         valid = chk.merge_valid((r or {}).get("valid?")

@@ -118,6 +118,16 @@ def validate_entries(entries) -> int:
                     k.get("value"), (int, float)):
                 raise ValueError(
                     f"entry {i}: kernel {name!r} bad value: {k!r}")
+        # optional search-shape fields (witness position, frontier
+        # peak, states explored — jepsen_tpu.tpu.wgl's explorer): the
+        # cross-run view of how the search's shape drifts
+        s = e.get("search")
+        if s is not None:
+            if not isinstance(s, dict) or not all(
+                    isinstance(v, (int, float)) and not isinstance(
+                        v, bool)
+                    for v in s.values()):
+                raise ValueError(f"entry {i}: bad search stats {s!r}")
         n += 1
     return n
 

@@ -281,6 +281,15 @@ def write_linear_trace_excerpt(store_dir, analysis: dict,
     fp = _fingerprint(tuple(idxs))
     body = [f"linearizability counterexample: trace excerpts for "
             f"participating ops {sorted(idxs)}", ""]
+    search = (analysis or {}).get("search")
+    if isinstance(search, dict) and \
+            search.get("witness-position") is not None:
+        # where in the history the search got stuck (the explorer's
+        # witness percentile) — localization context for the reader
+        body.insert(1, "witnessed at "
+                    f"{search['witness-position'] * 100:.1f}% of the "
+                    f"history (entry {search.get('witness-entry')} of "
+                    f"{search.get('entries')})")
     body.extend(trace_excerpt_lines(by_op, sorted(idxs)))
     window = _op_window(by_op, sorted(idxs))
     if window is not None:

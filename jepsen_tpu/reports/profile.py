@@ -90,6 +90,15 @@ def kernel_rows(metrics: dict | None) -> list[dict]:
             "split": split,
             "iterations": _fmt_count(st.get("iterations"))
             if st.get("iterations") else "-",
+            # the search explorer's per-kernel shape: peak BFS frontier
+            # occupancy, states explored, and dedup hits
+            # (jepsen_tpu.tpu.wgl._drain / doc/observability.md)
+            "frontier": _fmt_count(st.get("frontier_peak"))
+            if st.get("frontier_peak") else "-",
+            "states": _fmt_count(st.get("states"))
+            if st.get("states") else "-",
+            "dedup": _fmt_count(st.get("dedup_hits"))
+            if st.get("dedup_hits") else "-",
         })
     return rows
 
@@ -98,7 +107,9 @@ _COLS = (("kernel", "kernel"), ("launches", "launches"),
          ("cache", "cache hit"), ("flops", "FLOPs"),
          ("bytes", "bytes"), ("peak_mem", "peak mem"),
          ("compile", "compile"), ("wall", "wall"),
-         ("split", "wall split"), ("iterations", "iters"))
+         ("split", "wall split"), ("iterations", "iters"),
+         ("frontier", "frontier"), ("states", "states"),
+         ("dedup", "dedup"))
 
 
 def slowest_launches(events, top: int = 5) -> list[dict]:

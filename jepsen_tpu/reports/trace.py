@@ -147,6 +147,22 @@ def _device_events(events: list, spans) -> int:
                           for k, v in s["attrs"].items()}
         events.append(ev)
         n += 1
+        # search-explorer counter track: the launch's BFS frontier
+        # occupancy curve (jepsen_tpu.tpu.wgl._drain) spread over the
+        # launch's wall window, one `C` track per kernel
+        curve = (s.get("attrs") or {}).get("frontier_curve")
+        if isinstance(curve, list) and curve and all(
+                isinstance(x, (int, float)) for x in curve):
+            track = f"{kernel} frontier"
+            span_ns = max(s["t1"] - s["t0"], 1)
+            step = span_ns / len(curve)
+            for i, x in enumerate(curve):
+                events.append({
+                    "ph": "C", "name": track, "pid": _PID_DEVICE,
+                    "tid": tids.tid(track),
+                    "ts": _us(s["t0"] + i * step),
+                    "args": {"frontier": float(x)}})
+                n += 1
     return n
 
 

@@ -178,6 +178,18 @@ def analyze_run(d, resume: bool = False, test_fn=None,
                 [res.get("valid?")]
                 + [(v or {}).get("valid?") for v in orphans.values()
                    if isinstance(v, dict)])
+            # orphans were merged AFTER core.analyze's certificate
+            # stamping pass — validate their proofs too, or a resumed
+            # run's reused verdict would ride unvalidated
+            try:
+                from .tpu import certify as jcertify
+
+                jcertify.stamp_results(
+                    {k: v for k, v in orphans.items()
+                     if isinstance(v, dict)}, hist)
+            except Exception:  # noqa: BLE001 — best-effort
+                logger.exception("stamping orphaned certificates "
+                                 "failed")
         if isinstance(prev_results, dict):
             for k in ("degraded", "watchdog"):
                 if k in prev_results and k not in res:
@@ -188,6 +200,25 @@ def analyze_run(d, resume: bool = False, test_fn=None,
             "recovered-ops": n_ops,
             "resumed-checkers": resumed_names,
         }
+        # verdict-certificate outcomes (stamped inside core.analyze
+        # against the recovered history) summarized for the offline
+        # reader: a crashed run whose re-analysis carries validated
+        # proofs is as trustworthy as an uninterrupted one
+        try:
+            from .tpu import certify as jcertify
+
+            counts = {"certified": 0, "errors": 0, "absent": 0}
+            for _path, r in jcertify.iter_certificates(res):
+                if "absent" in (r.get("certificate") or {}):
+                    counts["absent"] += 1
+                elif r.get("certificate-error"):
+                    counts["errors"] += 1
+                elif r.get("certified"):
+                    counts["certified"] += 1
+            if any(counts.values()):
+                test["results"]["analysis"]["certificates"] = counts
+        except Exception:  # noqa: BLE001 — summary is best-effort
+            logger.exception("summarizing certificates failed")
     # results.json only: save_results would retire the store-wide
     # `current` symlink (owned by whichever run is live right now) and
     # clobber the run's original test.json with the rebuilt map

@@ -11,6 +11,7 @@ when naming gap-filling suite configs."""
 from importlib import import_module
 
 SUITES = {
+    "aerospike": "jepsen_tpu.suites.aerospike",
     "cockroach": "jepsen_tpu.suites.cockroach",
     "consul": "jepsen_tpu.suites.consul",
     "dgraph": "jepsen_tpu.suites.dgraph",

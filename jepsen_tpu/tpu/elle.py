@@ -40,6 +40,7 @@ from typing import Any
 import numpy as np
 
 from .. import history as h
+from .. import telemetry
 from ..history import History
 from .. import txn as txnlib
 
@@ -191,8 +192,11 @@ class AppendAnalysis:
                         break
 
     def _edges(self) -> list[tuple[int, int, int]]:
-        """(src txn idx, dst txn idx, edge type)."""
+        """(src txn idx, dst txn idx, edge type). Per-key data-edge
+        counts accumulate in self.key_edges — the search explorer's
+        per-key cost attribution."""
         edges: list[tuple[int, int, int]] = []
+        self.key_edges: dict = defaultdict(int)
         committed = [t for t in self.txns if t.type == h.OK]
         # ww along each spine; wr/rw from each read's last element
         for k, sp in self.spine.items():
@@ -203,6 +207,7 @@ class AppendAnalysis:
                     continue  # aborted writers are G1a, not graph nodes
                 if prev is not None and prev.i != w[0].i:
                     edges.append((prev.i, w[0].i, WW))
+                    self.key_edges[k] += 1
                 prev = w[0]
         nxt: dict = {}
         for k, sp in self.spine.items():
@@ -238,6 +243,7 @@ class AppendAnalysis:
                 if (w is not None and w[0].i != t.i
                         and w[0].type != h.FAIL):
                     edges.append((w[0].i, t.i, WR))
+                    self.key_edges[k] += 1
                 # anti-dependency: reader -> writer of the next version
                 nv = nxt.get((k, last))
                 if nv is not None:
@@ -245,6 +251,7 @@ class AppendAnalysis:
                     if (w is not None and w[0].i != t.i
                             and w[0].type != h.FAIL):
                         edges.append((t.i, w[0].i, RW))
+                        self.key_edges[k] += 1
             else:
                 # An external read of [] precedes EVERY install on this
                 # key: in any serial order consistent with it, t runs
@@ -255,6 +262,7 @@ class AppendAnalysis:
                 for wt in _targets(k).values():
                     if wt.i != t.i:
                         edges.append((t.i, wt.i, RW))
+                        self.key_edges[k] += 1
         edges.extend(_order_edges(committed))
         return list(dict.fromkeys(edges))
 
@@ -520,6 +528,42 @@ def annotate_op_indices(result: dict, hist) -> dict:
 _DEVICE_MIN_OPS = 4000
 
 
+def _with_search(result: dict, key_edges: dict | None = None) -> dict:
+    """Attaches result['search'] — the search explorer's elle half:
+    edge volume, witnessing-cycle count, and (host engine) the per-key
+    edge cost attribution. Mirrored into elle.search.* telemetry so
+    the profile CLI and ledger see search-shape drift."""
+    s: dict = {"edges": int(result.get("edge-count") or 0),
+               "txns": int(result.get("txn-count") or 0)}
+    cycles = sum(1 for recs in (result.get("anomalies") or {}).values()
+                 for rec in recs
+                 if isinstance(rec, dict) and rec.get("steps"))
+    s["cycles"] = cycles
+    if key_edges:
+        top = sorted(key_edges.items(), key=lambda kv: (-kv[1],
+                                                        str(kv[0])))
+        s["keys"] = len(key_edges)
+        s["per-key-edges"] = {str(k): int(v) for k, v in top[:8]}
+    telemetry.count("elle.search.edges", s["edges"])
+    if cycles:
+        telemetry.count("elle.search.cycles", cycles)
+    result["search"] = s
+    return result
+
+
+def _finish(result: dict, hist, family: str,
+            opts: dict | None, key_edges: dict | None = None) -> dict:
+    """Shared tail of both public checks: search stats always, a
+    verdict certificate when the caller opted in (checker wrappers
+    pass opts['certify']; raw bench calls don't pay for proofs)."""
+    _with_search(result, key_edges)
+    if (opts or {}).get("certify"):
+        from . import certify as certify_mod
+
+        certify_mod.attach_elle(hist, result, family)
+    return result
+
+
 def _degrade_to_host(which: str, e: Exception) -> list[str]:
     """Device-engine failure (XLA OOM / compile): count the ladder
     rung and fall back to the host reference engine, which computes
@@ -558,9 +602,9 @@ def check_list_append(hist, opts: dict | None = None) -> dict:
                               and len(hist) >= _DEVICE_MIN_OPS):
         from . import elle_device
         try:
-            return _with_classes(annotate_op_indices(
+            return _finish(_with_classes(annotate_op_indices(
                 elle_device.check_list_append_device(hist), hist),
-                CHECKED_APPEND)
+                CHECKED_APPEND), hist, "list-append", opts)
         except elle_device.Unvectorizable:
             if engine == "device":
                 raise
@@ -581,8 +625,9 @@ def check_list_append(hist, opts: dict | None = None) -> dict:
     }
     if degraded:
         out["degradation"] = degraded
-    return _with_classes(annotate_op_indices(out, hist),
-                         CHECKED_APPEND)
+    return _finish(_with_classes(annotate_op_indices(out, hist),
+                                 CHECKED_APPEND),
+                   hist, "list-append", opts, a.key_edges)
 
 
 def check_rw_register(hist, opts: dict | None = None) -> dict:
@@ -608,9 +653,9 @@ def check_rw_register(hist, opts: dict | None = None) -> dict:
         from . import elle_device
 
         try:
-            return _with_classes(annotate_op_indices(
+            return _finish(_with_classes(annotate_op_indices(
                 elle_device.check_rw_register_device(hist), hist),
-                CHECKED_WR)
+                CHECKED_WR), hist, "rw-register", opts)
         except elle_device.Unvectorizable:
             pass  # host edge inference below; SCC still on device
         except Exception as e:  # noqa: BLE001 — device ladder
@@ -657,6 +702,7 @@ def check_rw_register(hist, opts: dict | None = None) -> dict:
                 expected[k] = v
 
     edges: list[tuple[int, int, int]] = []
+    key_edges: dict = defaultdict(int)
     succ: dict = {}  # (k, v) -> next written value, when proven
     for t in txns:
         if t.type != h.OK:
@@ -681,6 +727,7 @@ def check_rw_register(hist, opts: dict | None = None) -> dict:
                                 {"key": k, "value": v, "op": t.op,
                                  "writer": iw.op})
                         edges.append((w.i, t.i, WR))
+                        key_edges[k] += 1
                 last_read[k] = v
             elif f == "w":
                 # write-follows-read: proven ww + version succession
@@ -689,6 +736,7 @@ def check_rw_register(hist, opts: dict | None = None) -> dict:
                     pw = writer.get((k, _freeze(pv)))
                     if pw is not None and pw.i != t.i:
                         edges.append((pw.i, t.i, WW))
+                        key_edges[k] += 1
                     succ[(k, _freeze(pv))] = v
     for t in txns:
         if t.type != h.OK:
@@ -701,6 +749,7 @@ def check_rw_register(hist, opts: dict | None = None) -> dict:
                 w = writer.get((k, _freeze(nv)))
                 if w is not None and w.i != t.i and w.type == h.OK:
                     edges.append((t.i, w.i, RW))
+                    key_edges[k] += 1
     committed = [t for t in txns if t.type == h.OK]
     cyc = None
     if want_device:
@@ -736,5 +785,7 @@ def check_rw_register(hist, opts: dict | None = None) -> dict:
     }
     if degraded:
         out["degradation"] = degraded
-    return _with_classes(annotate_op_indices(out, hist), CHECKED_WR)
+    return _finish(_with_classes(annotate_op_indices(out, hist),
+                                 CHECKED_WR),
+                   hist, "rw-register", opts, key_edges)
 

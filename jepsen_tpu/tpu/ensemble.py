@@ -34,12 +34,15 @@ def _jitted_sharded(mesh, W: int, F: int, max_iters: int, reach: bool):
 
     repl = NamedSharding(mesh, P())
     shard = NamedSharding(mesh, P("b"))
-    # the trailing output is the scalar iteration count (replicated)
+    # trailing outputs: the scalar iteration count plus the three
+    # batch-summed search-shape level series (all replicated — XLA
+    # all-reduces the per-shard partial sums)
+    stats = (repl, repl, repl, repl)
     return jax.jit(
         partial(_kernel, W=W, F=F, max_iters=max_iters, reach=reach),
         in_shardings=(repl, repl, repl, repl, repl, shard, shard),
-        out_shardings=((shard, shard, repl) if reach
-                       else (shard, repl)))
+        out_shardings=((shard, shard) + stats if reach
+                       else (shard,) + stats))
 
 
 def default_mesh(n_devices: int | None = None):
@@ -152,5 +155,5 @@ def analysis_batch_sharded(model, hists, mesh=None, W: int | None = None,
                 out = wgl_mod.extract_witness(encs[j], W=W, F=F)
                 out["analyzer"] = ("tpu-sharded" if r == wgl_mod.INVALID
                                    else "tpu+host-fallback")
-                results[i] = out
+                results[i] = wgl_mod._search_stats(out)
     return results

@@ -293,6 +293,16 @@ class Profiler:
             tel.count(f"profiler.{k}.iterations", int(rec["iterations"]))
         if rec.get("rows"):
             tel.count(f"profiler.{k}.rows", int(rec["rows"]))
+        # search-explorer aggregates (wgl _drain attaches the series)
+        if rec.get("states_explored"):
+            tel.count(f"profiler.{k}.states",
+                      int(rec["states_explored"]))
+        if rec.get("dedup_hits"):
+            tel.count(f"profiler.{k}.dedup_hits",
+                      int(rec["dedup_hits"]))
+        if rec.get("frontier_peak"):
+            tel.gauge_max(f"profiler.{k}.frontier_peak",
+                          int(rec["frontier_peak"]))
         if rec.get("flops"):
             tel.count(f"profiler.{k}.flops", int(rec["flops"]))
         if rec.get("bytes_accessed"):

@@ -125,6 +125,11 @@ def search_host(enc: Encoded, witness: bool = False) -> dict:
     out: dict = {"valid?": False}
     if witness:
         out["op"] = enc.entry_ops[best_p] if best_p < m else None
+        # search-dynamics telemetry: where in the history the search
+        # got stuck — the witness-position percentile feeding the
+        # coverage atlas and ROADMAP-3's early-exit tuning
+        out["witness-entry"] = int(best_p)
+        out["entry-count"] = int(m)
         cfgs = []
         for p, wmask, st in best_cfgs:
             # pending = every unlinearized entry in flight at the stuck
@@ -250,6 +255,8 @@ def search_host_model(model, hist: History, witness: bool = False) -> dict:
     out: dict = {"valid?": False}
     if witness:
         out["op"] = ops[best_p] if best_p < m else None
+        out["witness-entry"] = int(best_p)
+        out["entry-count"] = int(m)
         out["configs"] = [{"model": st, "pending":
                            [ops[p + i] for i in range(wmask.bit_length() + 1)
                             if p + i < m and not (wmask >> i) & 1][:4]}
@@ -491,7 +498,14 @@ def _kernel(inv_t, ret_t, trans, mseg, sufmin, row_seg, st0,
     reach=True: exhausts each history's search and returns
     (out_mask uint32 [B] — bit s set iff final state s is reachable —
     and unknown bool [B]); used by the segment-parallel long-history
-    path, which composes per-segment reachability. Requires S <= 32."""
+    path, which composes per-segment reachability. Requires S <= 32.
+
+    Search-dynamics telemetry: both modes also return three int32
+    [max_iters] level-series — live frontier configs entering each BFS
+    level, unique successor states produced by it, and dedup hits
+    (generated minus unique) — summed over the batch. _drain folds
+    them into profiler records and wgl.search.* telemetry; cost is
+    three scalar reductions + dynamic_update_slice per level."""
     import jax
     import jax.numpy as jnp
 
@@ -534,7 +548,8 @@ def _kernel(inv_t, ret_t, trans, mseg, sufmin, row_seg, st0,
     iota_l = jnp.arange(L, dtype=jnp.int32)
 
     def body(carry):
-        p, mask, st, result, out_mask, ovf, it = carry
+        (p, mask, st, result, out_mask, ovf, it,
+         lvl_live, lvl_new, lvl_dup) = carry
         live = p < INFi                                       # [B, F]
         # slab absolute entry range [it-W, it+2W+8)
         slab_iv = jax.lax.dynamic_slice(inv_p, (0, it), (K, L))
@@ -586,6 +601,7 @@ def _kernel(inv_t, ret_t, trans, mseg, sufmin, row_seg, st0,
                            nmask >> t_ones.astype(jnp.uint32))
         running = (result == RUNNING)[:, None, None]
         ok0 = apply_ok & live[:, :, None] & ~cfg_ovf[:, :, None] & running
+        gen_n = jnp.sum(ok0)
         if crash_free:
             # no crashed entries anywhere in the batch: the discard
             # action never fires, so successors are half as wide and
@@ -596,6 +612,7 @@ def _kernel(inv_t, ret_t, trans, mseg, sufmin, row_seg, st0,
             ss = jnp.where(ok0, st_nxt, 0).reshape(B, N)
         else:
             ok1 = disc_ok & live[:, :, None] & ~cfg_ovf[:, :, None] & running
+            gen_n = gen_n + jnp.sum(ok1)
             sp = jnp.stack([jnp.where(ok0, s_p, INFi),
                             jnp.where(ok1, s_p, INFi)], axis=3)
             sm = jnp.stack([jnp.where(ok0, s_mask, 0),
@@ -656,10 +673,17 @@ def _kernel(inv_t, ret_t, trans, mseg, sufmin, row_seg, st0,
         # freeze resolved histories
         frozen = (result != RUNNING)[:, None]
         sp = jnp.where(frozen, INFi, sp)
-        return sp, sm, ss, result, out_mask, new_ovf, it + 1
+        # per-level search-shape samples (batch-summed)
+        new_n = jnp.sum(n_uniq).astype(jnp.int32)
+        lvl_live = lvl_live.at[it].set(jnp.sum(live).astype(jnp.int32))
+        lvl_new = lvl_new.at[it].set(new_n)
+        lvl_dup = lvl_dup.at[it].set(
+            jnp.maximum(gen_n.astype(jnp.int32) - new_n, 0))
+        return (sp, sm, ss, result, out_mask, new_ovf, it + 1,
+                lvl_live, lvl_new, lvl_dup)
 
     def cond(carry):
-        _, _, _, result, _, _, it = carry
+        result, it = carry[3], carry[6]
         return jnp.any(result == RUNNING) & (it < max_iters)
 
     p0 = jnp.full((B, F), BIG, dtype=jnp.int32).at[:, 0].set(0)
@@ -670,18 +694,22 @@ def _kernel(inv_t, ret_t, trans, mseg, sufmin, row_seg, st0,
     out0 = jnp.where(m == 0, u1 << jnp.minimum(
         st0.astype(jnp.uint32), 31), jnp.uint32(0))
     p0 = jnp.where((res0 != RUNNING)[:, None], jnp.int32(BIG), p0)
-    carry = (p0, mask0, sts0, res0, out0, ovf0, jnp.int32(0))
+    lvl0 = jnp.zeros(max_iters, dtype=jnp.int32)
+    carry = (p0, mask0, sts0, res0, out0, ovf0, jnp.int32(0),
+             lvl0, lvl0, lvl0)
     carry = jax.lax.while_loop(cond, body, carry)
-    p, mask, st, result, out_mask, ovf, it = carry
+    (p, mask, st, result, out_mask, ovf, it,
+     lvl_live, lvl_new, lvl_dup) = carry
     if debug:
         return p, mask, st, result, out_mask, ovf, it
     result = jnp.where(result == RUNNING, UNKNOWN, result)
-    # `it` rides along so callers can account while-loop iterations
-    # without a debug launch (see _drain)
+    # `it` + the level series ride along so callers can account
+    # while-loop iterations and search shape without a debug launch
+    # (see _drain)
     if reach:
         unknown = (result == UNKNOWN) | ovf
-        return out_mask, unknown, it
-    return result, it
+        return out_mask, unknown, it, lvl_live, lvl_new, lvl_dup
+    return result, it, lvl_live, lvl_new, lvl_dup
 
 
 # kernel shape buckets this process has already compiled: first launch
@@ -767,12 +795,24 @@ def _launch(pb: PackedBatch, rows: Sequence[tuple[int, int]], W: int,
         lower=lambda: _jitted_kernel().lower(*args, **kw), meta=meta)
 
 
+def _downsample(xs, n: int = 32) -> list[int]:
+    """At most n evenly-spaced samples of a level series (profiler
+    span attrs carry the curve; a segment search can run 8k levels)."""
+    xs = list(xs)
+    if len(xs) <= n:
+        return [int(x) for x in xs]
+    step = len(xs) / n
+    return [int(xs[int(i * step)]) for i in range(n)]
+
+
 def _drain(out, reach: bool):
     """Materializes a launch's outputs (blocking on the device),
     recording the host wait as execute time plus the kernel's
-    while-loop iteration count, and closing the launch's profiler
-    record (device-compute wait, D2H readback). Returns result [B]
-    (reach=False) or (out_mask, unknown) arrays (reach=True)."""
+    while-loop iteration count and search-shape series (frontier
+    occupancy / states explored / dedup hits per BFS level), and
+    closing the launch's profiler record (device-compute wait, D2H
+    readback). Returns result [B] (reach=False) or (out_mask, unknown)
+    arrays (reach=True)."""
     tel = telemetry.get()
     prof = profiler.get()
     rec = prof.take(out)
@@ -785,19 +825,36 @@ def _drain(out, reach: bool):
         pass
     t_ready = _time.monotonic_ns()
     if reach:
-        mask, unk, it = out
+        mask, unk, it, lvl_live, lvl_new, lvl_dup = out
         res = (np.asarray(mask), np.asarray(unk))
     else:
-        r, it = out
+        r, it, lvl_live, lvl_new, lvl_dup = out
         res = np.asarray(r)
     n_it = int(it)
+    live = np.asarray(lvl_live)[:n_it]
+    new = np.asarray(lvl_new)[:n_it]
+    dup = np.asarray(lvl_dup)[:n_it]
+    peak = int(live.max()) if live.size else 0
+    states = int(new.sum())
+    dedup = int(dup.sum())
     t1 = _time.monotonic_ns()
     tel.count("wgl.kernel.execute_ns", t1 - t0)
     tel.count("wgl.kernel.iterations", n_it)
+    # the search explorer's aggregate counters (per-launch series ride
+    # in the profiler record / kernel:<k> span attrs)
+    tel.count("wgl.search.levels", n_it)
+    tel.count("wgl.search.states", states)
+    tel.count("wgl.search.dedup-hits", dedup)
+    if peak:
+        tel.gauge_max("wgl.search.frontier-peak", peak)
     if rec is not None:
         rec["compute_ns"] = t_ready - t0
         rec["d2h_ns"] = t1 - t_ready
         rec["iterations"] = n_it
+        rec["frontier_peak"] = peak
+        rec["states_explored"] = states
+        rec["dedup_hits"] = dedup
+        rec["frontier_curve"] = _downsample(live)
         prof.finish(rec)
     return res
 
@@ -1174,6 +1231,7 @@ def check_segmented(enc: Encoded, target_len: int | None = None,
     if ckpt is not None:
         ckpt.save(resolved)
     reach = 1 << enc.init_state
+    reaches = [reach]  # reachable-state mask entering each segment
     for k in range(K):
         nreach = 0
         for s in range(S):
@@ -1188,17 +1246,59 @@ def check_segmented(enc: Encoded, target_len: int | None = None,
         if nreach == 0:
             res: dict = {"valid?": False, "failed-segment": k,
                          "segment-range": [cuts[k], cuts[k + 1]]}
+            wstate = next(s for s in range(S) if (reach >> s) & 1)
+            chain = _reach_chain(resolved, reaches, k, wstate)
+            if chain is not None:
+                # the reach/choice data a certificate re-derives the
+                # pre-witness linearization from (jepsen_tpu.tpu
+                # .certify); also where the witness sits in the
+                # history — the early-exit signal (ROADMAP item 3)
+                res["search-chain"] = {"cuts": [int(c) for c in cuts],
+                                       "chain": chain}
             if witness:
-                for s in range(S):
-                    if (reach >> s) & 1:
-                        w = search_host(segs[k].with_init(s),
-                                        witness=True)
-                        res.update({kk: v for kk, v in w.items()
-                                    if kk != "valid?"})
-                        break
+                w = search_host(segs[k].with_init(wstate),
+                                witness=True)
+                res.update({kk: v for kk, v in w.items()
+                            if kk != "valid?"})
+                if "witness-entry" in res:
+                    # globalize the segment-local stuck entry
+                    res["witness-entry"] = int(
+                        cuts[k] + res["witness-entry"])
+                    res["entry-count"] = int(enc.m)
             return res
         reach = nreach
-    return {"valid?": True, "segments": K}
+        reaches.append(reach)
+    final_state = next(s for s in range(S) if (reach >> s) & 1)
+    chain = _reach_chain(resolved, reaches, K, final_state)
+    res = {"valid?": True, "segments": K}
+    if chain is not None:
+        res["search-chain"] = {"cuts": [int(c) for c in cuts],
+                               "chain": chain}
+    return res
+
+
+def _reach_chain(resolved: dict, reaches: list[int], upto: int,
+                 final_state: int) -> list[int] | None:
+    """A concrete per-segment start-state chain out of the resolved
+    reach masks: chain[j] is segment j's start state, chain[upto] =
+    final_state, and resolved[(j, chain[j])] contains chain[j+1] for
+    every j — the choice data certificates compose per-segment
+    linearization orders along. Backward reconstruction; None when a
+    mask is missing (shouldn't happen after composition resolved
+    them)."""
+    chain = [0] * (upto + 1)
+    chain[upto] = int(final_state)
+    for j in range(upto - 1, -1, -1):
+        nxt = chain[j + 1]
+        for s in range(32):
+            if (reaches[j] >> s) & 1:
+                mask = resolved.get((j, s))
+                if mask is not None and (mask >> nxt) & 1:
+                    chain[j] = s
+                    break
+        else:
+            return None
+    return chain
 
 
 # ---------------------------------------------------------------------------
@@ -1273,9 +1373,28 @@ def extract_witness(enc: Encoded, W: int | None = None,
     return _witness_op_indices(out)
 
 
+def _search_stats(out: dict) -> dict:
+    """Attaches out['search'] — the witness-position percentile
+    ("nonlinearizable witnessed at 12% of the history") for invalid
+    verdicts: the direct input for segment-level early-exit (ROADMAP
+    item 3) and the coverage atlas's anomaly-localization ranking."""
+    if out.get("valid?") is not False:
+        return out
+    we = out.get("witness-entry")
+    m = out.get("entry-count")
+    if we is None and "segment-range" in out:
+        we = out["segment-range"][0]
+    if we is not None and m:
+        out["search"] = {"witness-entry": int(we),
+                         "entries": int(m),
+                         "witness-position": round(int(we) / int(m),
+                                                   4)}
+    return out
+
+
 def analysis(model, hist, algorithm: str = "tpu", W: int | None = None,
              F: int | None = None, checkpoint_path=None,
-             checkpoint_dir=None) -> dict:
+             checkpoint_dir=None, certify: bool = False) -> dict:
     """Checks a single history against a model.
 
     algorithm: 'tpu'  — device kernel, host fallback on UNKNOWN
@@ -1284,17 +1403,29 @@ def analysis(model, hist, algorithm: str = "tpu", W: int | None = None,
     Result mirrors knossos analysis maps: {'valid?': bool, 'op': ...,
     'configs': [...], 'analyzer': ...}. When the device kernel failed
     (OOM / compile) and analysis stepped down the degradation ladder,
-    the verdict carries the rungs walked as result['degradation']."""
+    the verdict carries the rungs walked as result['degradation'].
+
+    certify=True (the checker entry points pass it; raw bench paths
+    don't) additionally attaches a machine-checkable proof of the
+    verdict as result['certificate'] (jepsen_tpu.tpu.certify) — for
+    valid, a per-segment linearization order re-derived from the reach
+    chain; for invalid, the replayable blocked-frontier witness."""
     with _ladder_scope() as steps:
+        enc_box: list = [None]
         out = _analysis(model, hist, algorithm, W, F, checkpoint_path,
-                        checkpoint_dir)
+                        checkpoint_dir, enc_box)
         if steps:
             out["degradation"] = list(steps)
+        _search_stats(out)
+        if certify:
+            from . import certify as certify_mod
+
+            certify_mod.attach_wgl(model, hist, enc_box[0], out)
         return out
 
 
 def _analysis(model, hist, algorithm, W, F, checkpoint_path,
-              checkpoint_dir) -> dict:
+              checkpoint_dir, enc_box: list | None = None) -> dict:
     if not isinstance(hist, History):
         hist = History(hist)
     try:
@@ -1303,6 +1434,8 @@ def _analysis(model, hist, algorithm, W, F, checkpoint_path,
         out = search_host_model(model, hist, witness=True)
         out["analyzer"] = "model"
         return _witness_op_indices(out)
+    if enc_box is not None:
+        enc_box[0] = enc  # certificate extraction reuses the encode
 
     if algorithm == "model":
         out = search_host_model(model, hist, witness=True)
@@ -1352,12 +1485,15 @@ def _analysis(model, hist, algorithm, W, F, checkpoint_path,
 
 def analysis_batch_streamed(model, hists: Sequence, chunk: int = 256,
                             W: int | None = None,
-                            F: int | None = None) -> list[dict]:
+                            F: int | None = None,
+                            certify: bool = False) -> list[dict]:
     """analysis_batch with host->HBM pipelining (SURVEY P7): histories
     are encoded and launched chunk by chunk, and because JAX dispatch
     is asynchronous, chunk i+1's host-side encoding overlaps chunk i's
     device search. A one-chunk drain lag keeps at most two chunks of
-    packed tensors live on the host while preserving the overlap."""
+    packed tensors live on the host while preserving the overlap.
+    certify=True attaches a per-result verdict certificate (the
+    checker batch path passes it; the raw bench path doesn't)."""
     hists = list(hists)
     results: list[dict] = [None] * len(hists)  # type: ignore
 
@@ -1374,7 +1510,10 @@ def analysis_batch_streamed(model, hists: Sequence, chunk: int = 256,
             except EncodingError:
                 out = search_host_model(model, hh, witness=True)
                 out["analyzer"] = "model"
-                results[i] = _witness_op_indices(out)
+                results[i] = _witness_op_indices(_search_stats(out))
+                if certify_mod is not None:
+                    certify_mod.attach_wgl(model, hh, None,
+                                           results[i])
         if not encs:
             return None
         try:
@@ -1390,6 +1529,10 @@ def analysis_batch_streamed(model, hists: Sequence, chunk: int = 256,
         except Exception as e:  # noqa: BLE001 — device ladder
             return (None, encs, idx_map,
                     [_ladder_classify(e, "streamed launch")])
+
+    certify_mod = None
+    if certify:
+        from . import certify as certify_mod  # noqa: PLC0415
 
     def drain(entry):
         dev, encs, idx_map, rungs = entry
@@ -1427,6 +1570,10 @@ def analysis_batch_streamed(model, hists: Sequence, chunk: int = 256,
                 own = [s for k, s in enumerate(own)
                        if k == 0 or own[k - 1] != s]
                 results[i].setdefault("degradation", own)
+            _search_stats(results[i])
+            if certify_mod is not None:
+                certify_mod.attach_wgl(model, hists[i], encs[j],
+                                       results[i])
 
     with _ladder_scope():
         pending = None
@@ -1443,9 +1590,11 @@ def analysis_batch_streamed(model, hists: Sequence, chunk: int = 256,
 
 
 def analysis_batch(model, hists: Sequence, W: int | None = None,
-                   F: int | None = None) -> list[dict]:
+                   F: int | None = None,
+                   certify: bool = False) -> list[dict]:
     """Checks many histories at once (the ensemble path: one device
     launch for the whole batch, host fallback only for UNKNOWNs)."""
     hists = list(hists)
     return analysis_batch_streamed(model, hists,
-                                   chunk=max(len(hists), 1), W=W, F=F)
+                                   chunk=max(len(hists), 1), W=W, F=F,
+                                   certify=certify)

@@ -193,13 +193,18 @@ def coverage_cell_html(cells, fault: str, workload: str) -> str:
         links = " ".join(
             f"<a href='/files/{_html.escape(r)}/'>{_html.escape(r)}"
             "</a>" for r in c["witnesses"][:8])
+        frac = c.get("earliest-witness-frac")
+        at = (f"{frac * 100:.0f}%"
+              if isinstance(frac, (int, float)) else "-")
         rows.append(
             "<tr>"
             f"<td>{_html.escape(cls)}</td><td>{c['runs']}</td>"
             f"<td>{c['witnessed']}</td><td>{c['clean']}</td>"
-            f"<td>{c['unknown']}</td><td>{links}</td></tr>")
+            f"<td>{c['unknown']}</td><td>{at}</td>"
+            f"<td>{links}</td></tr>")
     body = ("<table><tr><th>anomaly class</th><th>runs</th>"
             "<th>witnessed</th><th>clean</th><th>unknown</th>"
+            "<th>earliest witness</th>"
             "<th>witnessing runs</th></tr>" + "".join(rows)
             + "</table>") if rows else \
         "<p>never exercised — a coverage gap.</p>"
@@ -322,6 +327,118 @@ def _profile_html(d: Path, rel: str) -> str:
             "prometheus metrics</a></p>")
 
 
+def _sparkline_svg(curve, width: int = 240, height: int = 36) -> str:
+    """An inline polyline sparkline for a frontier-occupancy curve."""
+    vals = [float(x) for x in curve]
+    top = max(vals) or 1.0
+    n = max(len(vals) - 1, 1)
+    pts = " ".join(
+        f"{i * width / n:.1f},{height - v / top * (height - 2):.1f}"
+        for i, v in enumerate(vals))
+    return (f"<svg width='{width}' height='{height}' "
+            "style='vertical-align:middle'>"
+            f"<polyline points='{pts}' fill='none' "
+            "stroke='#6DB6FE' stroke-width='1.5'/></svg>")
+
+
+def search_index(res, prefix: str = "", depth: int = 0) -> list:
+    """[(label, search-dict)] for every checker result carrying
+    search-dynamics stats (witness position; jepsen_tpu.tpu.wgl)."""
+    out: list = []
+    if not isinstance(res, dict) or depth > 5:
+        return out
+    s = res.get("search")
+    if isinstance(s, dict) and s.get("witness-position") is not None:
+        out.append((prefix or "result", s))
+    for k, v in sorted(res.items(), key=lambda kv: str(kv[0])):
+        if isinstance(v, dict) and k not in ("anomalies", "search"):
+            out.extend(search_index(v, prefix=f"{prefix}/{k}"
+                                    if prefix else str(k),
+                                    depth=depth + 1))
+    return out
+
+
+def certificate_rows(res) -> list:
+    """[(path, status)] for every certified result in a results tree
+    (status: 'certified', 'error: ...', or 'absent: ...')."""
+    from .tpu import certify as jcertify
+
+    rows = []
+    for path, r in jcertify.iter_certificates(res or {}):
+        cert = r.get("certificate") or {}
+        if "absent" in cert:
+            rows.append((path, f"absent: {cert['absent']}"))
+        elif r.get("certificate-error"):
+            rows.append((path, f"ERROR: {r['certificate-error']}"))
+        elif r.get("certified"):
+            rows.append((path, "certified"))
+        else:
+            rows.append((path, "unvalidated"))
+    return rows
+
+
+def _explorer_html(d: Path, rel: str) -> str:
+    """The search-explorer panel: per-kernel frontier-growth
+    sparklines (from the profiler's kernel:<k> telemetry spans), the
+    witness-position markers each invalid verdict carries, and the
+    run's verdict-certificate statuses (doc/observability.md)."""
+    try:
+        events, _metrics = jstore.load_telemetry(d)
+    except Exception:  # noqa: BLE001 — panel must not 500 the page
+        events = []
+    curves = []
+    for e in events or []:
+        name = str(e.get("name", ""))
+        attrs = e.get("attrs") or {}
+        curve = attrs.get("frontier_curve")
+        if (name.startswith("kernel:") and isinstance(curve, list)
+                and curve):
+            curves.append((e.get("t1", 0) - e.get("t0", 0),
+                           name[len("kernel:"):], curve, attrs))
+    curves.sort(key=lambda c: -c[0])
+    try:
+        res = jstore.load_results(d)
+    except (OSError, json.JSONDecodeError):
+        res = None
+    witnesses = search_index(res) if res else []
+    certs = certificate_rows(res) if res else []
+    if not curves and not witnesses and not certs:
+        return ""
+    parts = ["<h2>search explorer</h2>"]
+    if curves:
+        parts.append("<p>frontier growth per BFS level (largest "
+                     "launches)</p><ul>")
+        for _dur, kernel, curve, attrs in curves[:4]:
+            levels = attrs.get("iterations", "?")
+            label = (f"{kernel}: peak "
+                     f"{attrs.get('frontier_peak', '?')} configs, "
+                     f"{levels} levels, "
+                     f"{attrs.get('states_explored', '?')} states")
+            parts.append(f"<li>{_sparkline_svg(curve)} "
+                         f"{_html.escape(label)}</li>")
+        parts.append("</ul>")
+    for label, s in witnesses[:8]:
+        frac = float(s["witness-position"])
+        pct = round(frac * 100, 1)
+        marker = (
+            "<svg width='240' height='12' "
+            "style='vertical-align:middle'>"
+            "<rect x='0' y='4' width='240' height='4' fill='#eee'/>"
+            f"<rect x='{frac * 240 - 1.5:.1f}' y='0' width='3' "
+            "height='12' fill='#FEB5DA'/></svg>")
+        parts.append(f"<p>{marker} <b>{_html.escape(label)}</b>: "
+                     f"witnessed at {pct}% of the history</p>")
+    if certs:
+        items = "".join(
+            f"<li><b>{_html.escape(p)}</b>: {_html.escape(st)}</li>"
+            for p, st in certs[:16])
+        parts.append("<p>verdict certificates "
+                     f"(<a href='/files/{_html.escape(rel)}/"
+                     "results.json'>proofs ride in results.json</a>)"
+                     f"</p><ul>{items}</ul>")
+    return "".join(parts)
+
+
 def _nodes_html(d: Path) -> str:
     """The per-node observability lanes (jepsen_tpu.nodeprobe):
     resource strips + DB-log event markers + gap/breaker ticks under
@@ -361,6 +478,7 @@ def dir_html(rel: str, d: Path) -> str:
     anomalies = ""
     profile = ""
     nodes = ""
+    explorer = ""
     if (d / "test.json").exists():
         # a run directory: link its rendered views next to the raw files
         run_rel = _html.escape(rel.rstrip("/"))
@@ -369,6 +487,10 @@ def dir_html(rel: str, d: Path) -> str:
                  f"<a href='/trace/{run_rel}'>perfetto json</a></p>")
         anomalies = _anomaly_html(rel.rstrip("/"), d)
         nodes = _nodes_html(d)
+        try:
+            explorer = _explorer_html(d, rel.rstrip("/"))
+        except Exception:  # noqa: BLE001 — panel must not 500
+            logger.exception("rendering search explorer failed")
         profile = _profile_html(d, rel.rstrip("/"))
     return (f"<!DOCTYPE html><html><head><style>"
             "table { border-collapse: collapse } "
@@ -376,7 +498,8 @@ def dir_html(rel: str, d: Path) -> str:
             "border-bottom: 1px solid #eee; font-size: 13px }"
             "</style></head><body>"
             f"<h1>{_html.escape(rel)}</h1>"
-            f"{views}{anomalies}{nodes}{profile}<ul>{items}</ul>"
+            f"{views}{anomalies}{explorer}{nodes}{profile}"
+            f"<ul>{items}</ul>"
             "</body></html>")
 
 

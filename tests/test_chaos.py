@@ -683,6 +683,69 @@ class TestDegradationLadder:
             "elle.ladder.host-fallback"] == 1
 
 
+class TestChaosCertificates:
+    """ISSUE-10 satellite: harness fault injection never yields a
+    verdict whose certificate fails to validate — an honest
+    `certificate: absent` is allowed (host floors, non-replayable
+    models), a validating-but-wrong proof never is. The stamp itself
+    runs inside core.analyze; these tests assert its outcome under
+    seeded chaos."""
+
+    def _cert_checker(self):
+        from jepsen_tpu.checker import models
+
+        return checker.compose({
+            "linear": checker.linearizable(
+                {"model": models.cas_register(0)}),
+            "stats": checker.stats()})
+
+    @staticmethod
+    def assert_certificates_honest(results):
+        from jepsen_tpu.tpu import certify
+
+        seen = 0
+        for path, res in certify.iter_certificates(results):
+            seen += 1
+            cert = res["certificate"]
+            certify.validate_schema(cert)
+            # the invariant: certified XOR honestly absent — never a
+            # proof that failed validation
+            if "absent" in cert:
+                continue
+            assert res.get("certified") is True, \
+                (path, res.get("certificate-error"))
+        assert seen >= 1, "no certificates to check — suite is moot"
+
+    def test_chaos_run_certificates_validate(self, tmp_path):
+        telemetry.reset()
+        t = chaos_run(tmp_path, "chaos-certs", client_rates={
+            "drop-connection": 0.15, "command-timeout": 0.1,
+            "exception": 0.05}, checker_=self._cert_checker())
+        assert_invariants(t, tmp_path)
+        assert sum(t["client"].tally.values()) > 0  # faults really flew
+        self.assert_certificates_honest(t["results"])
+
+    def test_forced_device_failure_keeps_proofs_honest(
+            self, tmp_path, monkeypatch):
+        """The degradation ladder's host floor still produces a
+        verdict whose certificate validates (extraction is host-side
+        and kernel-independent) — or says absent; never a bad proof."""
+        from jepsen_tpu.tpu import wgl
+
+        def boom(*a, **kw):
+            raise RuntimeError("RESOURCE_EXHAUSTED: chaos-forced oom")
+
+        monkeypatch.setattr(wgl, "_launch", boom)
+        telemetry.reset()
+        t = chaos_run(tmp_path, "chaos-certs-floor",
+                      checker_=self._cert_checker())
+        assert_invariants(t, tmp_path)
+        res = t["results"]
+        assert res["linear"].get("degradation"), \
+            "the ladder never walked — forcing failed"
+        self.assert_certificates_honest(res)
+
+
 class TestRecoverableFlag:
     def test_live_pid_suppresses_recoverable(self, tmp_path):
         """A quiet-but-running test (single checker computing for
