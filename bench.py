@@ -471,6 +471,67 @@ def _check_regression(line):
     return line
 
 
+# graftlint aggregates from bench_lint_wall, folded into the perf
+# ledger entry so the SPMD PR can show R3/R4 going to zero.
+_LINT_AGGREGATES: dict = {}
+
+
+def bench_lint_wall():
+    """graftlint tax (jepsen_tpu.analysis): the full static pass —
+    abstract kernel traces at the default shape buckets, R1-R6, the
+    host-feeder dtype audit, the concurrency lint, and the committed
+    baseline gate — exactly what tier-1 runs. The first pass pays
+    one-time jax tracing of every kernel (cached in-process after,
+    like the headline's compile note); the BENCH value is that cold
+    wall, priced against the headline's 60s/1M-event budget
+    (vs_baseline = lint-seconds per budget; the ISSUE-12 bound is
+    < 0.02 — the gate must stay ~free next to a real run)."""
+    import statistics as _st
+
+    from jepsen_tpu.analysis import driver
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    baseline = os.path.join(here, "lint-baseline.json")
+    import jax  # noqa: F401 — process startup, not lint cost: in a
+    # bench run jax is imported long before this line; don't bill its
+    # one-time import to the first lint pass when run standalone
+
+    t0 = time.time()
+    rep = driver.run_lint()
+    cold = time.time() - t0
+    warm = []
+    for _ in range(3):
+        t0 = time.time()
+        rep = driver.run_lint()
+        warm.append(time.time() - t0)
+    if os.path.exists(baseline):
+        driver.gate(rep, baseline)
+    new = len(rep.ratchet["new"]) if rep.ratchet is not None else None
+    _LINT_AGGREGATES.update(rep.aggregates())
+    agg = rep.aggregates()
+    budget_s = 60.0
+    fraction = cold / budget_s
+    _log(f"lint-wall: cold {cold:.2f}s warm median "
+         f"{_st.median(warm):.2f}s ({fraction:.4f}x of the headline "
+         f"budget) — {len(rep.findings)} finding(s), "
+         f"{new if new is not None else '?'} new vs baseline, "
+         f"R3 non-donated {agg['non_donated_bytes'] // 1024} KiB, "
+         f"R4 unsharded axes {agg['unsharded_axes']}")
+    line = {
+        "metric": "graftlint full static pass wall time (kernel "
+                  "traces + R1-R6 + concurrency lint + baseline "
+                  "gate; cold, first pass in process)",
+        "value": round(cold, 3),
+        "unit": "s",
+        "vs_baseline": round(fraction, 4),
+        "warm_s": round(_st.median(warm), 3),
+        "findings": len(rep.findings),
+    }
+    if new is not None:
+        line["new_findings"] = new
+    return line
+
+
 def bench_monitor_overhead(n_ops=4000):
     """Live-monitor + watchdog tax on the interpreter hot loop: the
     same dummy-client run with and without the observers attached.
@@ -888,6 +949,15 @@ def _ledger_entry(lines, headline):
     }
     if search:
         out["search"] = search
+    if _LINT_AGGREGATES:
+        # the R3/R4 aggregates the SPMD rebuild (ROADMAP items 1-2)
+        # must drive to zero, tracked per round like the kernels
+        out["lint"] = {
+            "non_donated_bytes": _LINT_AGGREGATES["non_donated_bytes"],
+            "replicated_bytes": _LINT_AGGREGATES["replicated_bytes"],
+            "unsharded_axes": _LINT_AGGREGATES["unsharded_axes"],
+            "findings": dict(_LINT_AGGREGATES.get("findings", {})),
+        }
     return out
 
 
@@ -1001,6 +1071,7 @@ def main():
     lines = []
     if not os.environ.get("BENCH_SKIP_EXTRAS"):
         for fn, args in ((bench_monitor_overhead, ()),
+                         (bench_lint_wall, ()),
                          (bench_trace_overhead, ()),
                          (bench_nodeprobe_overhead, ()),
                          (bench_coverage_overhead,

@@ -583,6 +583,47 @@ def coverage_cmd(all_workloads=None) -> dict:
     return {"coverage": {"parser_fn": build, "run": run}}
 
 
+def lint_cmd() -> dict:
+    """A 'lint' subcommand: graftlint — static analysis of the
+    compiled device kernels (host-sync, dtype-widening, donation
+    misses, sharding-readiness, recompile risk, carry bloat) plus the
+    threaded modules' lock-discipline lint, gated by the committed
+    baseline ratchet (jepsen_tpu.analysis; doc/static-analysis.md).
+    Abstract tracing only: CPU-safe, no execution — tier-1 runs
+    `lint --baseline lint-baseline.json`. Exit: 0 clean or fully
+    baselined, 1 NEW findings, 2 a kernel failed to trace."""
+    def build(p):
+        # the driver owns the full flag set; mirror it here so
+        # `lint --help` works through the standard dispatcher
+        p.add_argument("--baseline", default=None, metavar="FILE")
+        p.add_argument("--update", action="store_true")
+        p.add_argument("--json", action="store_true", dest="json_")
+        p.add_argument("--runtime-buckets", action="store_true")
+        p.add_argument("--full", action="store_true")
+        p.add_argument("--rules", default=None, metavar="R1,R2,...")
+        return p
+
+    def run(options):
+        from .analysis import driver
+
+        argv = []
+        if options.baseline:
+            argv += ["--baseline", options.baseline]
+        if options.update:
+            argv.append("--update")
+        if options.json_:
+            argv.append("--json")
+        if options.runtime_buckets:
+            argv.append("--runtime-buckets")
+        if options.full:
+            argv.append("--full")
+        if options.rules:
+            argv += ["--rules", options.rules]
+        return driver.main(argv)
+
+    return {"lint": {"parser_fn": build, "run": run}}
+
+
 def serve_cmd() -> dict:
     """A 'serve' subcommand for the web UI (cli.clj:336-354)."""
     def build(p):

@@ -128,6 +128,23 @@ def validate_entries(entries) -> int:
                         v, bool)
                     for v in s.values()):
                 raise ValueError(f"entry {i}: bad search stats {s!r}")
+        # optional graftlint aggregates (jepsen_tpu.analysis): the
+        # R3/R4 numbers the SPMD rebuild drives to zero — non-donated
+        # bytes, replicated bytes, unsharded batch-axis count, plus a
+        # per-rule findings breakdown
+        li = e.get("lint")
+        if li is not None:
+            if not isinstance(li, dict):
+                raise ValueError(f"entry {i}: bad lint stats {li!r}")
+            for k, v in li.items():
+                if k == "findings":
+                    if not isinstance(v, dict) or not all(
+                            isinstance(x, int) for x in v.values()):
+                        raise ValueError(
+                            f"entry {i}: bad lint findings {v!r}")
+                elif not isinstance(v, int) or isinstance(v, bool):
+                    raise ValueError(
+                        f"entry {i}: bad lint field {k!r}: {v!r}")
         n += 1
     return n
 

@@ -147,6 +147,16 @@ class Monitor:
 
     DEFAULT_INTERVAL_S = 1.0
 
+    # concurrency-lint contract (jepsen_tpu.analysis.concurrency,
+    # doc/static-analysis.md): the interpreter hooks and the sampler
+    # thread race on these; writes happen under _lock only. The
+    # lifecycle attrs (_out/_thread/_stopped) are driven from the
+    # controlling thread and deliberately not listed.
+    _guarded_by_lock = {"_lock": (
+        "_hist", "_completed", "_dispatched", "_stalls", "_inflight",
+        "_nemesis_active", "_probe_gauges", "_points",
+        "_last_t", "_last_completed", "_last_stalls")}
+
     def __init__(self, test: dict | None = None,
                  interval_s: float | None = None):
         test = test or {}

@@ -359,6 +359,13 @@ class NodeProbe:
     MEM_FREE_WARN_FRAC = 0.05
     CPU_BUSY_WARN_FRAC = 0.98
 
+    # concurrency-lint contract (jepsen_tpu.analysis.concurrency,
+    # doc/static-analysis.md): per-node threads all funnel records
+    # through _emit under _lock. Per-node _NodeState objects are
+    # owned by their node's thread (unshared) and the lifecycle
+    # attrs by the controlling thread; neither is listed.
+    _guarded_by_lock = {"_lock": ("_records",)}
+
     def __init__(self, test: dict | None = None,
                  interval_s: float | None = None):
         test = test or {}
@@ -925,14 +932,18 @@ class synthetic_responder:  # noqa: N801 — callable factory, used as one
     chains behind other responders (jepsen_tpu.__main__ chains it
     after the partitioner's getent/ip-link answers)."""
 
+    # concurrency-lint contract: the dummy remote calls this from
+    # every probe thread; node state mutates under _lock only
+    _guarded_by_lock = {"_lock": ("_nodes",)}
+
     def __init__(self, seed: int = 7):
         self.seed = seed
         self._lock = threading.Lock()
         self._nodes: dict[str, dict] = {}
 
     # per-tick increments are seeded per node: deterministic across
-    # runs, distinct across nodes
-    def _state(self, node) -> dict:
+    # runs, distinct across nodes; _locked suffix = caller holds _lock
+    def _state_locked(self, node) -> dict:
         key = str(node)
         st = self._nodes.get(key)
         if st is None:
@@ -979,7 +990,7 @@ class synthetic_responder:  # noqa: N801 — callable factory, used as one
         if MARK not in cmd:
             return None
         with self._lock:
-            st = self._state(node)
+            st = self._state_locked(node)
             self._advance(st)
             mem_free = max(512_000, 4_096_000 - st["tick"] * 37_000)
             out = [

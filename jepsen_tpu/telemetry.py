@@ -60,6 +60,13 @@ class Telemetry:
     """A span/counter/gauge recorder. Thread-safe; one global instance
     (get()) serves the whole process, but tests may make their own."""
 
+    # concurrency-lint contract (jepsen_tpu.analysis.concurrency,
+    # doc/static-analysis.md): shared-mutable state is written under
+    # _lock only. Per-thread span stacks live in _local (unshared by
+    # construction) and are deliberately not listed.
+    _guarded_by_lock = {"_lock": ("_spans", "_open", "_counters",
+                                  "_gauges", "_next_id", "_epoch")}
+
     def __init__(self, enabled: bool = True):
         self.enabled = enabled
         self._lock = threading.Lock()

@@ -234,7 +234,7 @@ def _encode(model, hist: History, max_states: int) -> Encoded:
     # table column instead of once per entry.
     identity = np.arange(n_states, dtype=np.int32)
     id_cols = (d_trans_arr == identity[:, None]).all(axis=0)  # [D]
-    op_idx = np.asarray(ent_op_idx, dtype=np.int64)
+    op_idx = np.asarray(ent_op_idx, dtype=np.int32)
     crashed_all = np.fromiter((e[2] for e in ents), dtype=bool,
                               count=len(ents))
     keep = np.flatnonzero(~(crashed_all & id_cols[op_idx]))

@@ -21,6 +21,7 @@ from functools import lru_cache
 
 from .. import telemetry
 from . import profiler
+from . import wgl as wgl_mod
 from .encode import Encoded
 from .wgl import PackedBatch, _drain, _kernel, _next_pow2, _timed_launch
 
@@ -38,11 +39,16 @@ def _jitted_sharded(mesh, W: int, F: int, max_iters: int, reach: bool):
     # batch-summed search-shape level series (all replicated — XLA
     # all-reduces the per-shard partial sums)
     stats = (repl, repl, repl, repl)
+    # segment tensors are donated like the single-device path's
+    # (wgl.DONATE_ARGNUMS): launch sites re-create device arrays per
+    # call, so XLA may reuse the replicated slabs as scratch
+    wgl_mod.quiet_unusable_donation()
     return jax.jit(
         partial(_kernel, W=W, F=F, max_iters=max_iters, reach=reach),
         in_shardings=(repl, repl, repl, repl, repl, shard, shard),
         out_shardings=((shard, shard) + stats if reach
-                       else (shard,) + stats))
+                       else (shard,) + stats),
+        donate_argnums=wgl_mod.DONATE_ARGNUMS)
 
 
 def default_mesh(n_devices: int | None = None):

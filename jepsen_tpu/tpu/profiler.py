@@ -146,6 +146,13 @@ class Profiler:
     park (call sites mutate them unconditionally) but nothing is
     aggregated, mirrored to telemetry, or cost-analyzed."""
 
+    # concurrency-lint contract (jepsen_tpu.analysis.concurrency,
+    # doc/static-analysis.md): these attrs are written under _lock
+    # only (or in *_locked methods whose callers hold it)
+    _guarded_by_lock = {"_lock": ("_records", "_pending",
+                                  "_bucket_cost", "_seen_buckets",
+                                  "cache_stats")}
+
     def __init__(self, enabled: bool = True):
         self.enabled = enabled
         self._lock = threading.Lock()
@@ -200,13 +207,20 @@ class Profiler:
 
     def bucket_fresh(self, site: str, bucket) -> bool:
         """First-sighting test for launch sites without their own
-        compiled-bucket set (scc); counts the cache event too."""
+        compiled-bucket set (scc); counts the cache event too, and
+        gauges the site's distinct-bucket cardinality (set size, NOT
+        the miss counter: a failed first launch is unclaimed and
+        retried, and its second miss must not inflate the gauge) —
+        graftlint R5's runtime cross-check."""
         with self._lock:
             seen = self._seen_buckets.setdefault(site, set())
             fresh = bucket not in seen
             if fresh:
                 seen.add(bucket)
+            n = len(seen)
         self.cache_event(site, fresh)
+        if fresh:
+            telemetry.gauge(f"profiler.{site}.bucket_cardinality", n)
         return fresh
 
     def bucket_unclaim(self, site: str, bucket) -> None:
@@ -358,6 +372,28 @@ class Profiler:
             self._pending = {}
             self.cache_stats = {}
 
+    # -- shape buckets -----------------------------------------------------
+
+    def shape_buckets(self) -> dict[str, set]:
+        """Every compiled shape bucket this process has seen, per
+        launch site: this recorder's own seen-sets (scc et al) merged
+        with the wgl kernel's _compiled_buckets claim set (which the
+        single-device and mesh-sharded launch paths share). The
+        lint's trace-shape source (graftlint R5 cross-checks the
+        cardinality; the registry re-traces the real wgl shapes)."""
+        with self._lock:
+            out = {site: set(s)
+                   for site, s in self._seen_buckets.items()}
+        try:
+            from . import wgl as _wgl  # lazy: wgl imports this module
+
+            with _wgl._buckets_lock:  # snapshot vs concurrent claims
+                claimed = set(_wgl._compiled_buckets)
+            out.setdefault("wgl", set()).update(claimed)
+        except Exception:  # noqa: BLE001 — accessor is best-effort
+            logger.debug("wgl bucket set unavailable", exc_info=True)
+        return out
+
 
 _global = Profiler()
 
@@ -368,6 +404,11 @@ def get() -> Profiler:
 
 def reset() -> None:
     _global.reset()
+
+
+def shape_buckets() -> dict[str, set]:
+    """Module-level façade over Profiler.shape_buckets()."""
+    return _global.shape_buckets()
 
 
 # ---------------------------------------------------------------------------

@@ -35,6 +35,7 @@ import functools
 import logging
 import threading as _threading
 import time as _time
+import warnings as _warnings
 from typing import Any, Sequence
 
 import numpy as np
@@ -474,13 +475,40 @@ class PackedBatch:
         return row_seg, st0
 
 
+# The packed segment tensors (inv_t/ret_t/trans/mseg/sufmin — the big
+# per-launch H2D payload) are donated: every launch site converts its
+# numpy PackedBatch fields to fresh device arrays per call, so nothing
+# reads them after dispatch, and donation hands XLA the buffers as
+# scratch instead of keeping them live across the whole search
+# (graftlint R3; jepsen_tpu.analysis). Backends that can't alias them
+# (CPU) just ignore the donation, with an advisory warning per
+# compile — quieted by quiet_unusable_donation() below.
+DONATE_ARGNUMS = (0, 1, 2, 3, 4)
+
+
+def quiet_unusable_donation() -> None:
+    """Narrow filter for jax's 'Some donated buffers were not usable'
+    advisory, registered by the jit FACTORIES (not at import: merely
+    importing this library must not mutate global warning filters).
+    The filter is still process-global once a donated kernel is
+    built — a per-dispatch catch_warnings would race across the
+    checker thread pools — but it only fires for processes that
+    actually launch these kernels, and only for this one message.
+    (pytest resets filters per test; tests/conftest.py re-asserts
+    it.)"""
+    _warnings.filterwarnings(
+        "ignore", message="Some donated buffers were not usable")
+
+
 @functools.lru_cache(maxsize=None)
 def _jitted_kernel():
     import jax
 
+    quiet_unusable_donation()
     return jax.jit(_kernel, static_argnames=("W", "F", "max_iters",
                                              "reach", "debug",
-                                             "crash_free"))
+                                             "crash_free"),
+                   donate_argnums=DONATE_ARGNUMS)
 
 
 def _kernel(inv_t, ret_t, trans, mseg, sufmin, row_seg, st0,
@@ -741,10 +769,18 @@ def _timed_launch(bucket, dispatch, kernel: str = "wgl", lower=None,
         fresh = bucket not in _compiled_buckets
         if fresh:
             _compiled_buckets.add(bucket)
+        n_buckets = len(_compiled_buckets)
     tel = telemetry.get()
     prof = profiler.get()
     rec = prof.begin(kernel, bucket=bucket, **(meta or {}))
     prof.cache_event(kernel, fresh)
+    if fresh:
+        # distinct-bucket cardinality (set size, not the miss count:
+        # a failed first launch unclaims and retries without growing
+        # it) — graftlint R5's runtime cross-check. The wgl launch
+        # family (wgl/wgl-reach/wgl-sharded) shares one claim set, so
+        # each kernel's gauge reads the family total.
+        tel.gauge(f"profiler.{kernel}.bucket_cardinality", n_buckets)
     t0 = _time.monotonic_ns()
     try:
         out = dispatch()
@@ -945,11 +981,13 @@ def valid_cut_points(enc: Encoded) -> np.ndarray:
     cuts."""
     m = enc.m
     if m == 0:
-        return np.empty(0, dtype=np.int64)
+        return np.empty(0, dtype=np.int32)
     prefix_max = np.maximum.accumulate(enc.ret_t)
     valid = np.zeros(m, dtype=bool)
     valid[1:] = prefix_max[:-1] < enc.inv_t[1:]
-    return np.flatnonzero(valid)
+    # int32: entry indices stay < 2^21 (the kernel's rank range), so
+    # the 8-byte default index type just doubles the memory traffic
+    return np.flatnonzero(valid).astype(np.int32)
 
 
 def segment_cuts(enc: Encoded, target_len: int = 2048,

@@ -248,7 +248,8 @@ def home_html(base: Path | None = None) -> str:
             "table { border-collapse: collapse } "
             "td, th { padding: 4px 10px; text-align: left }"
             "</style></head><body><h1>Jepsen</h1>"
-            "<p><a href='/coverage/'>coverage atlas</a></p><table>"
+            "<p><a href='/coverage/'>coverage atlas</a> · "
+            "<a href='/lint'>graftlint</a></p><table>"
             "<tr><th>Test</th><th>Time</th><th>Valid?</th>"
             "<th colspan=5>Artifacts</th></tr>"
             + "".join(rows) + "</table></body></html>")
@@ -439,6 +440,147 @@ def _explorer_html(d: Path, rel: str) -> str:
     return "".join(parts)
 
 
+# graftlint report cache: the report describes the CODE, not a run,
+# so one compute per process serves every page (?refresh=1 re-lints).
+_lint_cache: dict = {}
+_lint_lock = threading.Lock()
+
+
+def _lint_baseline_path() -> Path:
+    return Path(__file__).resolve().parent.parent / "lint-baseline.json"
+
+
+def _compute_lint_report():
+    from .analysis import driver
+
+    rep = driver.run_lint()
+    bp = _lint_baseline_path()
+    if bp.exists():
+        driver.gate(rep, bp)
+    return rep
+
+
+def _lint_report(refresh: bool = False):
+    """Synchronous report for /lint (the user asked for it). The
+    compute runs OUTSIDE _lint_lock so concurrent page requests never
+    queue behind a trace; a racing duplicate compute is harmless."""
+    with _lint_lock:
+        rep = _lint_cache.get("rep")
+    if rep is not None and not refresh:
+        return rep
+    rep = _compute_lint_report()
+    with _lint_lock:
+        _lint_cache["rep"] = rep
+    return rep
+
+
+def _lint_report_cached():
+    """Non-blocking report for the run-page panel: the cached report,
+    or None after kicking off a one-shot background warm — a run page
+    must never stall multiple seconds on jax import + kernel tracing
+    inside the request handler."""
+    with _lint_lock:
+        rep = _lint_cache.get("rep")
+        if rep is not None:
+            return rep
+        if _lint_cache.get("warming"):
+            return None
+        _lint_cache["warming"] = True
+
+    def warm():
+        try:
+            r = _compute_lint_report()
+            with _lint_lock:
+                _lint_cache["rep"] = r
+        except Exception:  # noqa: BLE001 — warm is best-effort
+            logger.exception("lint warm failed")
+        finally:
+            with _lint_lock:
+                _lint_cache["warming"] = False
+
+    threading.Thread(target=warm, name="jepsen-lint-warm",
+                     daemon=True).start()
+    return None
+
+
+def lint_panel_html() -> str:
+    """The run-page graftlint panel: per-rule counts, the R3/R4
+    aggregates the SPMD rebuild tracks toward zero, and the baseline
+    gate status — linked to the full /lint report. Renders a warming
+    placeholder until the cached report exists."""
+    try:
+        rep = _lint_report_cached()
+    except Exception:  # noqa: BLE001 — the panel must not 500 the page
+        logger.exception("lint panel failed")
+        return ""
+    if rep is None:
+        return ("<h2>graftlint</h2><p><a href='/lint'>report "
+                "warming…</a> (computed in the background; "
+                "refresh shortly)</p>")
+    agg = rep.aggregates()
+    rules = " ".join(f"{r}={n}" for r, n in agg["findings"].items()) \
+        or "clean"
+    gatetxt = ""
+    if rep.ratchet is not None:
+        new = len(rep.ratchet["new"])
+        gatetxt = (f" · baseline: <b style='color:#b00'>{new} NEW"
+                   "</b>" if new else " · baseline: ok")
+    return ("<h2>graftlint</h2><p>"
+            f"<a href='/lint'>{len(rep.findings)} finding(s)</a> "
+            f"({_html.escape(rules)}) · non-donated "
+            f"{agg['non_donated_bytes'] // 1024} KiB · replicated "
+            f"{agg['replicated_bytes'] // 1024} KiB · unsharded axes "
+            f"{agg['unsharded_axes']}{gatetxt}</p>")
+
+
+def lint_html(refresh: bool = False) -> str:
+    rep = _lint_report(refresh=refresh)
+    agg = rep.aggregates()
+    new_keys = ({f.key for f in rep.ratchet["new"]}
+                if rep.ratchet is not None else set())
+    rows = []
+    for f in rep.findings:
+        where = f"{f.file}:{f.line}" if f.file else ""
+        flag = "<b style='color:#b00'>NEW</b>" if f.key in new_keys \
+            else ("baselined" if rep.ratchet is not None else "")
+        rows.append(
+            f"<tr><td>{_html.escape(f.rule)}</td>"
+            f"<td>{_html.escape(f.kernel)}</td>"
+            f"<td>{_html.escape(f.site)}</td>"
+            f"<td>{_html.escape(f.message)}"
+            + (f"<br><i>fix: {_html.escape(f.hint)}</i>" if f.hint
+               else "")
+            + f"</td><td>{_html.escape(where)}</td>"
+            f"<td>{flag}</td></tr>")
+    stale = ""
+    if rep.ratchet is not None and rep.ratchet["stale"]:
+        stale = ("<p>stale baseline entries (fixed): "
+                 + ", ".join(_html.escape(k)
+                             for k in rep.ratchet["stale"])
+                 + " — prune with <code>python -m jepsen_tpu lint "
+                   "--baseline lint-baseline.json --update</code></p>")
+    return ("<!DOCTYPE html><html><head><style>"
+            "table { border-collapse: collapse } "
+            "td, th { padding: 3px 10px; text-align: left; "
+            "vertical-align: top; border-bottom: 1px solid #eee; "
+            "font-size: 13px }</style></head><body>"
+            "<h1>graftlint — device-kernel static analysis</h1>"
+            f"<p>{len(rep.findings)} finding(s) across "
+            f"{len(rep.traces)} kernel trace(s) in {rep.wall_s:.2f}s"
+            " · R3 non-donated "
+            f"{agg['non_donated_bytes'] // 1024} KiB · R4 replicated "
+            f"{agg['replicated_bytes'] // 1024} KiB · R4 unsharded "
+            f"axes {agg['unsharded_axes']} · "
+            "<a href='/lint?refresh=1'>re-lint</a> · "
+            "<a href='/lint?json=1'>json</a> · rule catalog: "
+            "doc/static-analysis.md</p>"
+            + stale
+            + "<table><tr><th>rule</th><th>kernel</th><th>site</th>"
+              "<th>finding</th><th>provenance</th><th>baseline</th>"
+              "</tr>" + "".join(rows) + "</table>"
+            "<p><a href='/'>home</a></p></body></html>")
+
+
 def _nodes_html(d: Path) -> str:
     """The per-node observability lanes (jepsen_tpu.nodeprobe):
     resource strips + DB-log event markers + gap/breaker ticks under
@@ -491,7 +633,7 @@ def dir_html(rel: str, d: Path) -> str:
             explorer = _explorer_html(d, rel.rstrip("/"))
         except Exception:  # noqa: BLE001 — panel must not 500
             logger.exception("rendering search explorer failed")
-        profile = _profile_html(d, rel.rstrip("/"))
+        profile = _profile_html(d, rel.rstrip("/")) + lint_panel_html()
     return (f"<!DOCTYPE html><html><head><style>"
             "table { border-collapse: collapse } "
             "td, th { padding: 3px 10px; text-align: left; "
@@ -743,6 +885,17 @@ class StoreHandler(BaseHTTPRequestHandler):
                         optrace=optrace, ops=ops, noderecs=noderecs)
                     self._send(200, json.dumps(doc).encode(),
                                "application/json")
+            elif path == "/lint" or path == "/lint/":
+                # graftlint: the device-kernel static-analysis report
+                # (jepsen_tpu.analysis; repo state, cached per process)
+                refresh = bool(query.get("refresh"))
+                if query.get("json"):
+                    rep = _lint_report(refresh=refresh)
+                    self._send(200,
+                               json.dumps(rep.to_dict()).encode(),
+                               "application/json")
+                else:
+                    self._send(200, lint_html(refresh).encode())
             elif path == "/coverage" or path.startswith("/coverage/"):
                 # the cross-run fault × workload × anomaly heatmap
                 # (jepsen_tpu.coverage); /coverage/<fault>/<workload>

@@ -44,3 +44,10 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers",
         "slow: long-running e2e, excluded from tier-1 (-m 'not slow')")
+    # the wgl kernels donate their packed segment tensors; backends
+    # that can't alias them (CPU, which tier-1 forces) warn per
+    # compile — pytest resets warning filters, so wgl.py's module
+    # filter needs re-asserting here
+    config.addinivalue_line(
+        "filterwarnings",
+        "ignore:Some donated buffers were not usable")
