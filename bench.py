@@ -789,6 +789,97 @@ def bench_fallback_overhead(n_hists=64, ops_each=300):
     }
 
 
+def bench_fleet_throughput(n_runs=8, ops_each=3000):
+    """Checking-as-a-service throughput (ISSUE 13): N concurrent
+    seeded runs streamed through ONE fleet server (chunked over the
+    socket, WAL'd, continuously batched across tenants into shared
+    device launches) vs the same N histories checked solo,
+    sequentially, one launch each — the baseline a tenant pool without
+    a fleet pays. Verdict parity is asserted per run. vs_baseline =
+    fleet aggregate ops/s over solo aggregate ops/s (>1 = the shared
+    pool beats N separate checkers); device utilization rides along as
+    mean histories per final launch (solo is by construction 1.0)."""
+    import shutil
+    import statistics as _st
+    import tempfile
+    import threading as _th
+
+    from jepsen_tpu.checker import models
+    from jepsen_tpu.fleet import client as fclient
+    from jepsen_tpu.fleet import scheduler as fsched
+    from jepsen_tpu.fleet import server as fserver
+    from jepsen_tpu.tpu import synth, wgl
+
+    hists = [synth.register_history(ops_each, seed=3000 + i)
+             for i in range(n_runs)]
+    total_ops = sum(len(h) for h in hists)
+    model = models.cas_register()
+
+    # solo baseline: each run checked alone (one launch per history)
+    wgl.analysis(model, hists[0])  # warm the kernel cache
+    t0 = time.time()
+    solo_res = [wgl.analysis(model, h) for h in hists]
+    solo_s = time.time() - t0
+
+    def one_round():
+        base = tempfile.mkdtemp(prefix="fleet-bench-")
+        sched = fsched.Scheduler(window_s=0.1)
+        srv = fserver.FleetServer(
+            base, scheduler=sched,
+            quotas=fserver.Quotas(max_tenants=n_runs + 1,
+                                  max_total_streams=2 * n_runs),
+            stream_checks=False).start()
+        out = {}
+        barrier = _th.Barrier(n_runs)
+
+        def tenant(i):
+            c = fclient.FleetClient(srv.addr, f"bench{i}", "r",
+                                    model="cas-register")
+            ops = list(hists[i])
+            for j in range(0, len(ops), 512):
+                c.send_chunk(ops[j:j + 512])
+            barrier.wait(timeout=60)
+            out[i] = c.finish(timeout_s=300)
+            c.close()
+
+        t0 = time.time()
+        threads = [_th.Thread(target=tenant, args=(i,))
+                   for i in range(n_runs)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.time() - t0
+        st = srv.stats()["scheduler"]
+        srv.stop()
+        shutil.rmtree(base, ignore_errors=True)  # WALs per round add up
+        return wall, out, st
+
+    one_round()  # warm (fleet path compiles its own shape buckets)
+    walls = []
+    for _ in range(3):
+        wall, out, st = one_round()
+        walls.append(wall)
+    fleet_s = _st.median(walls)
+    mism = sum(1 for i, r in enumerate(solo_res)
+               if out[i]["result"]["valid?"] != r["valid?"])
+    assert mism == 0, f"{mism} fleet verdicts diverged from solo"
+    launches = max(st["launches"], 1)
+    util = st["final_hists"] / launches
+    _log(f"fleet-throughput: {n_runs} tenants fleet {fleet_s:.2f}s "
+         f"vs solo {solo_s:.2f}s, {util:.1f} hists/launch "
+         f"(cross-tenant launches: {st['cross_tenant_launches']})")
+    return {
+        "metric": f"fleet-throughput ({n_runs} concurrent tenants vs "
+                  f"{n_runs} solo checks, verdict parity asserted)",
+        "value": round(total_ops / fleet_s, 1),
+        "unit": "ops/s",
+        "vs_baseline": round((total_ops / fleet_s)
+                             / (total_ops / solo_s), 3),
+        "hists_per_launch": round(util, 2),
+    }
+
+
 def bench_analyze_resume(n_ops=2000):
     """analyze --resume wall time (ISSUE 5): a stored run re-analyzed
     offline, resumed vs from scratch. vs_baseline = fresh_time /
@@ -892,6 +983,7 @@ _KERNEL_METRICS = (
     ("bank balance-conservation", "bank", True),
     ("ensemble linearizability", "wgl-ensemble", True),
     ("time-to-first-anomaly", "anomaly", False),
+    ("fleet-throughput", "fleet", True),
 )
 
 
@@ -1082,6 +1174,8 @@ def main():
                          (bench_certify_overhead,
                           (50_000 if small else 200_000,)),
                          (bench_analyze_resume, ()),
+                         (bench_fleet_throughput,
+                          ((8, 600) if small else (8, 3000))),
                          (bench_list_append,
                           (10_000 if small else 100_000,)),
                          (bench_rw_register,

@@ -309,6 +309,7 @@ def main(argv=None) -> None:
     commands.update(cli.analyze_cmd(make_test))
     commands.update(cli.coverage_cmd(list(workloads.REGISTRY)))
     commands.update(cli.lint_cmd())
+    commands.update(cli.fleet_cmd())
     cli.run_cli(commands, argv)
 
 

@@ -31,6 +31,12 @@ CONCURRENCY_MODULE_NAMES = (
     "jepsen_tpu.nodeprobe",
     "jepsen_tpu.interpreter",
     "jepsen_tpu.tpu.profiler",
+    # the fleet data plane: every threaded class annotated, C1/C2/C3
+    # gated in tier-1 (tests/test_lint.py + tests/test_fleet.py)
+    "jepsen_tpu.fleet.scheduler",
+    "jepsen_tpu.fleet.server",
+    "jepsen_tpu.fleet.client",
+    "jepsen_tpu.chaos",
 )
 
 

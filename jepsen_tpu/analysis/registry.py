@@ -241,6 +241,14 @@ SHARDED_BUCKETS = [
     {"label": "B1024xM512xS8", "K": 1025, "M": 512, "S": 8,
      "rows": 1024, "W": 32, "F": 64, "reach": False},
 ]
+SLICES_BUCKETS = [
+    # the fleet's cross-run slice launch (jepsen_tpu.fleet.scheduler
+    # via wgl.check_slices): ~32 tenant stream segments x a handful of
+    # live start states each — short segments, small state spaces,
+    # many rows (doc/fleet.md)
+    {"label": "B32xM512xS8", "K": 33, "M": 512, "S": 8, "rows": 128,
+     "W": 24, "F": 48, "reach": True, "crash_free": False},
+]
 SCC_BUCKETS = [
     # elle dependency graphs at the 100k-txn bench scale
     {"label": "N131072xE262144", "n_pad": 131072, "e_pad": 262144},
@@ -263,6 +271,11 @@ def entries() -> list[Entry]:
               "check_segmented per-segment reach rows"),
         Entry("wgl-sharded", _sharded_trace, SHARDED_BUCKETS,
               "check_batch_sharded mesh ensemble path"),
+        Entry("wgl-slices",
+              functools.partial(_wgl_trace,
+                                kernel_name="wgl-slices"),
+              SLICES_BUCKETS,
+              "check_slices fleet cross-tenant reach rows"),
         Entry("scc", _scc_trace, SCC_BUCKETS,
               "Orzan coloring SCC (elle_device cycle engine)"),
     ]

@@ -132,6 +132,8 @@ class ChaosRemote(Remote):
     """Wraps a Remote so every session misbehaves with seeded
     probabilities. `tally` (a Counter) records what was injected."""
 
+    _guarded_by_lock = {"_lock": ("_n_conns",)}
+
     def __init__(self, inner: Remote, seed=0, rates: dict | None = None,
                  connect_rate: float = 0.0):
         self.inner = inner
@@ -247,6 +249,103 @@ class CrashingNemesis(_jnemesis_core.Nemesis):
 
     def fault_kinds(self):
         return self.inner.fault_kinds()
+
+
+# ---------------------------------------------------------------------------
+# Fleet transport chaos
+# ---------------------------------------------------------------------------
+
+DEFAULT_FLEET_RATES = {
+    "drop-frame": 0.05,       # the frame vanishes (ack never comes)
+    "duplicate-frame": 0.04,  # sent twice (server dedups by seq)
+    "reorder-frame": 0.04,    # held back; rides behind the next frame
+    "truncate-frame": 0.03,   # half a frame, then the socket dies
+}
+
+
+class ChaosFleetTransport:
+    """Seeded chaos on the fleet client's frame stream
+    (jepsen_tpu.fleet.client.Transport seam): frames are dropped,
+    duplicated, reordered, or torn mid-frame with per-send
+    probabilities, driving the exact recovery machinery a hostile
+    network exercises — ack timeouts, seq-dedup, resync rewinds, and
+    torn-frame reconnects. The invariant (tests/test_fleet.py): a
+    chaos-wrapped stream still journals the identical op sequence and
+    yields the identical verdict + certificate as a clean one.
+
+    One injector per connection attempt keeps the fault schedule
+    deterministic per (seed, connection ordinal) even as retries
+    reconnect. `hello` frames are exempt from drop/reorder (a client
+    whose every hello is eaten isn't a transport test, it's a timeout
+    test) but NOT from truncate — a torn hello must also recover."""
+
+    _guarded_by_lock = {"_lock": ("_conns", "_inj", "_inj_sock",
+                                  "_held")}
+
+    def __init__(self, seed=0, rates: dict | None = None,
+                 tally: Counter | None = None):
+        self.seed = seed
+        self.rates = dict(DEFAULT_FLEET_RATES if rates is None
+                          else rates)
+        self.tally = tally if tally is not None else Counter()
+        self._lock = threading.Lock()
+        self._conns = 0
+        self._inj: Optional[_Injector] = None
+        self._inj_sock = None
+        self._held: Optional[bytes] = None  # reordered frame in limbo
+
+    def _injector(self, sock) -> _Injector:
+        # a new socket object = a new connection: fresh seeded stream
+        with self._lock:
+            if self._inj is None or self._inj_sock is not sock:
+                self._conns += 1
+                self._inj = _Injector(
+                    self.seed, ("fleet", self._conns),
+                    self.rates, self.tally)
+                self._inj_sock = sock
+                self._held = None
+            return self._inj
+
+    def send(self, sock, msg: dict) -> None:
+        import socket as _socket
+
+        from .fleet import wire
+
+        inj = self._injector(sock)
+        kind = inj.roll()
+        buf = wire.frame_msg(msg)
+        is_hello = msg.get("type") == "hello"
+        if kind == "truncate-frame" and len(buf) > 8:
+            # half a frame on the wire, then a dead socket: the
+            # receiver sees a torn tail and both sides resync
+            try:
+                sock.sendall(buf[:len(buf) // 2])
+                sock.shutdown(_socket.SHUT_RDWR)
+            except OSError:
+                pass
+            raise wire.FrameError("chaos: frame torn mid-send")
+        if kind == "drop-frame" and not is_hello:
+            return  # vanished: the ack timeout will notice
+        with self._lock:
+            held, self._held = self._held, None
+            if kind == "reorder-frame" and not is_hello \
+                    and held is None:
+                self._held = buf  # rides behind the NEXT frame
+                return
+        try:
+            if held is not None:
+                sock.sendall(buf + held)  # swapped order
+            else:
+                sock.sendall(buf)
+                if kind == "duplicate-frame":
+                    sock.sendall(buf)
+        except OSError as e:
+            raise wire.FrameError(f"send failed: {e}") from e
+
+    def recv(self, sock) -> dict:
+        from .fleet import wire
+
+        return wire.recv_msg(sock)
 
 
 # ---------------------------------------------------------------------------

@@ -624,6 +624,129 @@ def lint_cmd() -> dict:
     return {"lint": {"parser_fn": build, "run": run}}
 
 
+def fleet_cmd() -> dict:
+    """A 'fleet' subcommand: the checking-as-a-service data plane
+    (jepsen_tpu.fleet; doc/fleet.md).
+
+      fleet serve            run the always-on multi-tenant server
+      fleet submit <run>     stream a stored run's history.jlog to the
+                             fleet and print its verdict
+      fleet status           the server's per-tenant stats
+    """
+    def build(p):
+        p.add_argument("action", choices=["serve", "submit",
+                                          "status"])
+        p.add_argument("run_dir", nargs="?", default=None,
+                       help="submit: a stored run dir (or a "
+                            "history.jlog) to stream.")
+        p.add_argument("--base", default="store/fleet",
+                       help="Fleet state dir (WALs, verdicts, "
+                            "fleet.addr).")
+        p.add_argument("--addr", default=None,
+                       help="host:port (default: read "
+                            "<base>/fleet.addr).")
+        p.add_argument("-b", "--host", default="127.0.0.1")
+        p.add_argument("-p", "--port", type=int, default=0)
+        p.add_argument("--tenant", default="cli")
+        p.add_argument("--model", default="cas-register",
+                       help="Model spec for submit (see "
+                            "fleet.known_models()).")
+        p.add_argument("--initial", default=None,
+                       help="Initial value for register-family "
+                            "models (JSON scalar; e.g. 0 for a DB "
+                            "that seeds the register).")
+        p.add_argument("--weight", type=float, default=1.0,
+                       help="Weighted-fair-queue share for submit.")
+        p.add_argument("--chunk-ops", type=int, default=256)
+        p.add_argument("--max-tenants", type=int, default=8)
+        p.add_argument("--max-streams", type=int, default=16)
+        return p
+
+    def _addr(options):
+        if options.addr:
+            return options.addr
+        from pathlib import Path
+        try:
+            line = (Path(options.base)
+                    / "fleet.addr").read_text().splitlines()[0]
+            return line.strip()
+        except (OSError, IndexError):
+            raise CliError(
+                f"no fleet.addr under {options.base!r} — pass --addr "
+                "or start one with `fleet serve`")
+
+    def run(options):
+        import json as _json
+
+        from .fleet import client as fclient
+        from .fleet import server as fserver
+
+        if options.action == "serve":
+            quotas = fserver.Quotas(
+                max_tenants=options.max_tenants,
+                max_total_streams=options.max_streams)
+            srv = fserver.FleetServer(options.base, host=options.host,
+                                      port=options.port,
+                                      quotas=quotas).start()
+            host, port = srv.addr
+            print(f"fleet server on {host}:{port} "
+                  f"(base {options.base})")
+            try:
+                import time as _time
+                while True:
+                    _time.sleep(3600)
+            except KeyboardInterrupt:
+                srv.stop()
+            return 0
+        if options.action == "status":
+            c = fclient.FleetClient(_addr(options), options.tenant,
+                                    "status", observe=True)
+            print(_json.dumps(c.status(), indent=2, sort_keys=True))
+            c.close()
+            return 0
+        # submit: stream a stored history
+        if not options.run_dir:
+            raise CliError("fleet submit needs a run dir or .jlog")
+        from pathlib import Path
+
+        from .store import format as sformat
+
+        p = Path(options.run_dir)
+        log = p if p.suffix == ".jlog" else p / "history.jlog"
+        if not log.exists():
+            raise CliError(f"no history log at {log}")
+        run_name = (p.parent.name if p.suffix == ".jlog" else p.name
+                    ).replace(" ", "-") or "run"
+        initial = options.initial
+        if initial is not None:
+            try:
+                initial = _json.loads(initial)
+            except ValueError:
+                pass  # a bare string initial is legal
+        c = fclient.FleetClient(_addr(options), options.tenant,
+                                run_name, model=options.model,
+                                initial=initial,
+                                weight=options.weight)
+        ops: list = []
+        n = 0
+        for o in sformat.read_ops(log):
+            ops.append(o)
+            if len(ops) >= options.chunk_ops:
+                c.send_chunk(ops)
+                n += len(ops)
+                ops = []
+        if ops:
+            c.send_chunk(ops)
+            n += len(ops)
+        verdict = c.finish()
+        c.close()
+        print(_json.dumps(verdict, indent=2, sort_keys=True))
+        res = (verdict.get("result") or {}).get("valid?")
+        return 0 if res is True else 1 if res is False else 2
+
+    return {"fleet": {"parser_fn": build, "run": run}}
+
+
 def serve_cmd() -> dict:
     """A 'serve' subcommand for the web UI (cli.clj:336-354)."""
     def build(p):

@@ -403,6 +403,15 @@ def analyze(test: dict, store_ctx=None, extra_opts: dict | None = None
                     map(str, hr.ever_quarantined())),
                 "still-quarantined": sorted(
                     map(str, hr.quarantined()))}
+        # the fleet's verdict (with its certificate) rides next to the
+        # local one — informational: a tenant compares, it never
+        # replaces local checking (jepsen_tpu.fleet, doc/fleet.md)
+        streamer = test.get("_fleet_streamer")
+        if streamer is not None:
+            try:
+                test["results"]["fleet"] = streamer.result_summary()
+            except Exception:  # noqa: BLE001 — best-effort
+                logger.exception("collecting fleet verdict failed")
         # realtime-order verdicts (wgl linearizability, elle strict
         # variants) carry the clock skew actually measured during the
         # run: the node probe's per-tick offsets merged with the
@@ -458,6 +467,31 @@ def run(test: dict) -> dict:
             test = jstore.start_test(test)
         except ImportError:
             store_ctx = None
+
+    if test.get("fleet"):
+        # checking-as-a-service (jepsen_tpu.fleet): mirror the op log
+        # to the fleet mid-run; its verdict+certificate ride in the
+        # results as results['fleet'] NEXT to the authoritative local
+        # checkers. Best-effort — but never silent: a fleet that was
+        # requested and couldn't attach still yields an honest
+        # results['fleet'] = {'unavailable': reason}.
+        try:
+            from .fleet import client as jfleet_client
+            if test.get("history_writer") is None:
+                test["_fleet_streamer"] = jfleet_client.NoStream(
+                    "no history writer (unnamed test: no store)")
+            else:
+                writer, streamer = jfleet_client.attach(test)
+                test["history_writer"] = writer
+                test["_fleet_streamer"] = streamer
+        except Exception as e:  # noqa: BLE001 — never sink a run
+            logger.exception("attaching fleet streamer failed")
+            try:
+                from .fleet import client as jfleet_client
+                test["_fleet_streamer"] = jfleet_client.NoStream(
+                    f"attach failed: {e!r}"[:200])
+            except Exception:  # noqa: BLE001
+                pass
 
     try:
         # analyze runs INSIDE the relative-time scope so its telemetry

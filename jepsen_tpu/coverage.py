@@ -576,9 +576,13 @@ def _append_if_new(path: Path, have: dict, entry: dict) -> bool:
     if latest is not None and latest.get("digest") == entry["digest"]:
         return False
     path.parent.mkdir(parents=True, exist_ok=True)
-    with open(path, "a") as f:
-        f.write(json.dumps(entry))
-        f.write("\n")
+    # one os.write on an O_APPEND fd (ledger.atomic_append_line):
+    # concurrent runs appending to the shared atlas can interleave
+    # LINES but never bytes — newest-line-wins stays sound because no
+    # reader can ever see a spliced line
+    from . import ledger as jledger
+
+    jledger.atomic_append_line(path, json.dumps(entry))
     have[entry["run"]] = entry
     return True
 

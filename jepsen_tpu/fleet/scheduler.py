@@ -1,0 +1,548 @@
+"""Continuous cross-run batching: the fleet's shared-device scheduler.
+
+Inference serving stays viable under load by continuously batching
+heterogeneous requests into shared device launches; the fleet applies
+the same discipline to checker work. Tenants submit two kinds of work:
+
+  final   a complete streamed history -> a full wgl/elle verdict with
+          its certificate (the authoritative answer, identical to what
+          a solo run computes)
+  slice   one (encoded segment, start state) reach row of a LIVE
+          stream's prefix — the streaming check that tightens a
+          tenant's verdict while chunks are still arriving
+
+The batch loop drains per-tenant queues by deficit-weighted round
+robin (a tenant with weight 2 gets twice the rows of a weight-1
+tenant when both are backlogged; an idle tenant's unused share is
+redistributed, not wasted), then packs everything it drained into as
+few device launches as possible: slices from ALL tenants go into ONE
+`wgl.check_slices` launch; finals group per model and go through
+`wgl.analysis_batch_streamed` (P-compositionality, arXiv:1504.00204,
+justifies slicing; certificates make the shared pool trustworthy —
+the tenant validates the proof, not the pool).
+
+Failure discipline: a work item's `done` event is ALWAYS set (try/
+finally), so no crash in a batch can wedge a queue; device failures
+inside the kernels walk the PR-5 degradation ladder; if whole batches
+keep dying, a circuit breaker (closed/open/half-open, the
+control/health.py discipline) routes finals to the pure-host
+algorithm until a half-open probe succeeds — slower, never wrong.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from collections import deque
+from typing import Any
+
+from .. import telemetry
+from ..history import History
+
+logger = logging.getLogger(__name__)
+
+MAX_BATCH = 64          # items drained per batch round
+WINDOW_S = 0.02         # how long the loop waits to accumulate work
+QUANTUM = 8.0           # deficit credit per round per unit weight
+BREAKER_THRESHOLD = 3   # consecutive dead batches before opening
+BREAKER_COOLDOWN_S = 5.0
+
+
+class WorkItem:
+    """One unit of checker work. `done` is set exactly once, after
+    `result` (never both unset across an exception path)."""
+
+    __slots__ = ("kind", "tenant", "run", "payload", "result", "done")
+
+    def __init__(self, kind: str, tenant: str, run: str, payload):
+        self.kind = kind          # 'final' | 'slice'
+        self.tenant = tenant
+        self.run = run
+        self.payload = payload
+        self.result: Any = None
+        self.done = threading.Event()
+
+    def finish(self, result) -> None:
+        self.result = result
+        self.done.set()
+
+
+class _TenantQueue:
+    __slots__ = ("items", "weight", "deficit")
+
+    def __init__(self, weight: float = 1.0):
+        self.items: deque[WorkItem] = deque()
+        self.weight = max(float(weight), 0.1)
+        self.deficit = 0.0
+
+
+class _DeviceBreaker:
+    """Fleet-level device circuit: opens after consecutive WHOLE-batch
+    failures (the in-kernel ladder already absorbs partial ones),
+    half-opens after a cooldown to probe with one batch. Called only
+    from the batch thread — no lock needed."""
+
+    def __init__(self, threshold: int = BREAKER_THRESHOLD,
+                 cooldown_s: float = BREAKER_COOLDOWN_S):
+        self.threshold = threshold
+        self.cooldown_s = cooldown_s
+        self.failures = 0
+        self.opened_at: float | None = None
+
+    def allow_device(self) -> bool:
+        if self.opened_at is None:
+            return True
+        if time.monotonic() - self.opened_at >= self.cooldown_s:
+            return True  # half-open probe
+        return False
+
+    def record(self, ok: bool) -> None:
+        if ok:
+            if self.opened_at is not None:
+                telemetry.count("fleet.breaker.closed")
+            self.failures = 0
+            self.opened_at = None
+            return
+        self.failures += 1
+        if self.failures >= self.threshold and self.opened_at is None:
+            self.opened_at = time.monotonic()
+            telemetry.count("fleet.breaker.opened")
+            logger.warning("fleet device breaker OPEN after %d "
+                           "consecutive batch failures", self.failures)
+        elif self.opened_at is not None:
+            self.opened_at = time.monotonic()  # failed probe re-arms
+
+
+class Scheduler:
+    """The batch loop + per-tenant weighted-fair queues."""
+
+    _guarded_by_lock = {"_lock": ("_queues", "_order", "_pending",
+                                  "_stats")}
+
+    def __init__(self, max_batch: int = MAX_BATCH,
+                 window_s: float = WINDOW_S,
+                 quantum: float = QUANTUM):
+        self.max_batch = max_batch
+        self.window_s = window_s
+        self.quantum = quantum
+        self._lock = threading.Lock()
+        self._work = threading.Condition(self._lock)
+        self._queues: dict[str, _TenantQueue] = {}
+        self._order: deque[str] = deque()  # round-robin ring
+        self._pending = 0
+        self._stats = {"launches": 0, "items": 0, "slice_rows": 0,
+                       "final_hists": 0, "cross_tenant_launches": 0,
+                       "max_tenants_in_launch": 0, "host_floor": 0}
+        self._breaker = _DeviceBreaker()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # -- submission ------------------------------------------------------
+
+    def set_weight(self, tenant: str, weight: float) -> None:
+        with self._lock:
+            self._tq_locked(tenant).weight = max(float(weight), 0.1)
+
+    def _tq_locked(self, tenant: str) -> _TenantQueue:
+        q = self._queues.get(tenant)
+        if q is None:
+            q = self._queues[tenant] = _TenantQueue()
+            self._order.append(tenant)
+        return q
+
+    def submit(self, kind: str, tenant: str, run: str,
+               payload) -> WorkItem:
+        item = WorkItem(kind, tenant, run, payload)
+        with self._lock:
+            self._tq_locked(tenant).items.append(item)
+            self._pending += 1
+            self._work.notify()
+        telemetry.count(f"fleet.submit.{kind}")
+        return item
+
+    def pending(self, tenant: str | None = None) -> int:
+        with self._lock:
+            if tenant is None:
+                return self._pending
+            q = self._queues.get(tenant)
+            return len(q.items) if q is not None else 0
+
+    def stats(self) -> dict:
+        with self._lock:
+            out = dict(self._stats)
+            out["pending"] = self._pending
+        out["breaker_open"] = self._breaker.opened_at is not None
+        return out
+
+    # -- lifecycle -------------------------------------------------------
+
+    def start(self) -> "Scheduler":
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._loop,
+                                        name="fleet-scheduler",
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        with self._lock:
+            self._work.notify_all()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=30)
+        # no wedged queues, even at shutdown: whatever is still queued
+        # resolves 'unknown' instead of blocking its waiter forever
+        with self._lock:
+            leftovers = [i for q in self._queues.values()
+                         for i in q.items]
+            for q in self._queues.values():
+                q.items.clear()
+            self._pending = 0
+        for i in leftovers:
+            i.finish({"valid?": "unknown",
+                      "error": "fleet scheduler stopped"})
+
+    # -- the batch loop --------------------------------------------------
+
+    def _drain_fair_locked(self) -> list[WorkItem]:
+        """Deficit-weighted round robin over the tenant ring, up to
+        max_batch items. Caller holds the lock."""
+        batch: list[WorkItem] = []
+        if not self._pending:
+            return batch
+        ring = [t for t in self._order if self._queues[t].items]
+        for t in ring:
+            self._queues[t].deficit += self.quantum * \
+                self._queues[t].weight
+        # rotate the ring so no tenant is always first
+        self._order.rotate(-1)
+        progress = True
+        while len(batch) < self.max_batch and progress:
+            progress = False
+            for t in ring:
+                q = self._queues[t]
+                # interleaved sweeps, ~weight items per visit: the
+                # weight ratio holds WITHIN a batch (not just across
+                # batches), so one backlogged tenant can never starve
+                # another out of a launch
+                take = max(1, int(round(q.weight)))
+                while (take > 0 and q.items and q.deficit >= 1.0
+                       and len(batch) < self.max_batch):
+                    batch.append(q.items.popleft())
+                    self._pending -= 1
+                    q.deficit -= 1.0
+                    take -= 1
+                    progress = True
+        for t in ring:  # an emptied queue carries no credit forward
+            q = self._queues[t]
+            if not q.items:
+                q.deficit = 0.0
+        return batch
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            with self._lock:
+                if not self._pending:
+                    self._work.wait(timeout=0.2)
+                    if not self._pending:
+                        continue
+                # a short accumulation window so concurrent tenants'
+                # submissions land in ONE launch (continuous batching)
+            time.sleep(self.window_s)
+            with self._lock:
+                batch = self._drain_fair_locked()
+            if batch:
+                self._run_batch(batch)
+
+    def _run_batch(self, batch: list[WorkItem]) -> None:
+        tenants = {i.tenant for i in batch}
+        with self._lock:
+            st = self._stats
+            st["items"] += len(batch)
+            if len(tenants) > 1:
+                st["cross_tenant_launches"] += 1
+            st["max_tenants_in_launch"] = max(
+                st["max_tenants_in_launch"], len(tenants))
+        telemetry.count("fleet.batch.rounds")
+        telemetry.count("fleet.batch.items", len(batch))
+        telemetry.gauge_max("fleet.batch.tenants", len(tenants))
+        slices = [i for i in batch if i.kind == "slice"]
+        finals = [i for i in batch if i.kind == "final"]
+        try:
+            if slices:
+                self._run_slices(slices)
+        finally:
+            # finals still run if the slice pass died; and every item
+            # resolves no matter what (finish() below is uncond.)
+            if finals:
+                self._run_finals(finals)
+
+    def _run_slices(self, items: list[WorkItem]) -> None:
+        from ..tpu import wgl
+
+        pairs = [i.payload for i in items]  # (Encoded, start_state)
+        try:
+            out, unk = wgl.check_slices(pairs)
+            with self._lock:
+                self._stats["launches"] += 1
+                self._stats["slice_rows"] += len(pairs)
+            self._breaker.record(True)
+            for i, mask, u in zip(items, out, unk):
+                i.finish({"mask": int(mask), "unknown": bool(u)})
+        except Exception as e:  # noqa: BLE001 — never wedge a queue
+            logger.exception("fleet slice batch failed")
+            self._breaker.record(False)
+            for i in items:
+                if not i.done.is_set():
+                    i.finish({"mask": 0, "unknown": True,
+                              "error": repr(e)})
+
+    def _run_finals(self, items: list[WorkItem]) -> None:
+        """Finals grouped per model spec -> one batched launch per
+        group. payload: {'engine': 'wgl'|'elle', 'model': name,
+        'history': History}."""
+        groups: dict[tuple, list[WorkItem]] = {}
+        for i in items:
+            # the initial value is part of the model spec: different
+            # initials can't share one batched launch
+            key = (i.payload["model"], i.payload.get("initial"))
+            groups.setdefault(key, []).append(i)
+        for (model_name, initial), group in groups.items():
+            self._run_final_group(model_name, initial, group)
+
+    def _run_final_group(self, model_name: str, initial,
+                         group: list[WorkItem]) -> None:
+        from . import build_model, elle_checks
+
+        engine = group[0].payload["engine"]
+        hists = [g.payload["history"] for g in group]
+        try:
+            if engine == "wgl":
+                results = self._wgl_finals(
+                    build_model(model_name, initial), hists)
+            else:
+                check = elle_checks()[model_name]
+                results = [check(h, {"certify": True})
+                           for h in hists]
+            with self._lock:
+                self._stats["launches"] += 1
+                self._stats["final_hists"] += len(hists)
+            self._breaker.record(True)
+            for g, r in zip(group, results):
+                g.finish(r)
+        except Exception as e:  # noqa: BLE001 — never wedge a queue
+            logger.exception("fleet final batch failed (%s)",
+                             model_name)
+            self._breaker.record(False)
+            for g in group:
+                if not g.done.is_set():
+                    g.finish({"valid?": "unknown", "error": repr(e)})
+
+    def _wgl_finals(self, model, hists) -> list[dict]:
+        from ..tpu import wgl
+
+        if not self._breaker.allow_device():
+            # breaker open: the pure-host reference search — the fleet
+            # degrades to slower, never to wrong or wedged
+            with self._lock:
+                self._stats["host_floor"] += len(hists)
+            telemetry.count("fleet.breaker.host-finals", len(hists))
+            return [wgl.analysis(model, h, algorithm="wgl",
+                                 certify=True) for h in hists]
+        return wgl.analysis_batch_streamed(model, hists, certify=True)
+
+
+# ---------------------------------------------------------------------------
+# Streaming checks: verdicts tighten as chunks arrive
+# ---------------------------------------------------------------------------
+
+class StreamingRun:
+    """The online watchdog generalized into streaming wgl: as a
+    tenant's chunks arrive, the accumulated prefix is encoded, cut at
+    real-time-safe points, and each new segment is submitted as reach
+    slices (one row per live start state) that the scheduler packs
+    ACROSS tenants into shared launches. The live state-mask chain
+    tightens the verdict mid-run: mask 0 at any cut proves the full
+    history cannot linearize (tentative-invalid, minutes before fin);
+    a surviving chain reports how much of the stream is already
+    certified-reachable.
+
+    Soundness: a cut is taken only where the PREFIX's real-time order
+    forces all earlier entries before all later ones AND no streamed
+    op is still open (an open invocation encodes as crashed ret=INF,
+    which valid_cut_points already treats as forbidding later cuts).
+    Ops not yet streamed invoke later in history order than everything
+    already cut, so prefix cuts remain cuts of the final history.
+
+    add_ops is called under the server's per-run ingest lock (so
+    reconnect races can't reorder chunks) and is CHEAP — it appends
+    and, at most, spawns the step worker. All encode/cut work runs on
+    that worker thread, never on the chunk-ack path; slice results
+    return on the scheduler thread. Shared state advances under
+    `_lock`. (The worker re-encodes the whole prefix each step — fine
+    at streaming-chunk cadence; incremental encoding is the known
+    scaling lever when streams reach millions of ops.)
+    """
+
+    _guarded_by_lock = {"_lock": ("_ops", "_since", "_mask",
+                                  "_checked", "_state", "_inflight",
+                                  "_frac")}
+
+    STREAM_EVERY = 128  # re-encode the prefix every N new ops
+    MAX_SEG = 2048      # preferred segment length (soundness first:
+    # a further-out cut is taken when no valid cut lands within it)
+
+    def __init__(self, model_name: str, scheduler: Scheduler,
+                 tenant: str, run: str, initial=None):
+        from . import build_model, wgl_models
+
+        self.tenant = tenant
+        self.run = run
+        self.scheduler = scheduler
+        self.model_name = model_name
+        self._model = build_model(model_name, initial) \
+            if model_name in wgl_models() else None
+        self._ops: list = []
+        self._since = 0
+        self._lock = threading.Lock()
+        self._mask: int | None = None  # None until first segment
+        self._checked = 0              # entries certified so far
+        self._frac = 0.0
+        self._state = "streaming" if self._model is not None \
+            else "unsupported"
+        self._inflight = False
+
+    def add_ops(self, ops: list) -> None:
+        with self._lock:
+            self._ops.extend(ops)
+            self._since += len(ops)
+            # the due-credit is NOT reset here: step() consumes it
+            # only when a worker actually launches, so ops arriving
+            # while a segment is in flight keep their claim and the
+            # finishing _collect re-kicks for them
+            due = self._since >= self.STREAM_EVERY
+        if due:
+            self.step()
+
+    def status(self) -> dict:
+        with self._lock:
+            return {"state": self._state,
+                    "checked-frac": round(self._frac, 4),
+                    "ops": len(self._ops)}
+
+    # -- the streaming step ---------------------------------------------
+
+    def step(self) -> None:
+        """Kicks the step worker (if the run is streaming and no
+        segment is already in flight). The worker encodes the prefix
+        snapshot and submits the next unchecked segment's reach slices
+        — one segment in flight per run: its result chains into the
+        next segment's start states, while OTHER runs' slices fill the
+        same launch."""
+        with self._lock:
+            if self._state != "streaming" or self._inflight:
+                return
+            self._inflight = True
+            self._since = 0  # the worker now owns the due-credit
+        threading.Thread(
+            target=self._step_work,
+            name=f"fleet-stream-{self.tenant}-{self.run}",
+            daemon=True).start()
+
+    def _step_work(self) -> None:
+        from ..tpu import encode as enc_mod
+        from ..tpu import wgl
+
+        def settle(state: str | None = None) -> None:
+            """Early exit WITHOUT launching: the due-credit step()
+            consumed goes back (add_ops documents that credit is only
+            spent on a real launch), so the ops that earned it get
+            their check as soon as the next chunk — or _collect's
+            re-kick — fires."""
+            with self._lock:
+                self._inflight = False
+                if state is not None:
+                    self._state = state
+                elif self._since < self.STREAM_EVERY:
+                    self._since = self.STREAM_EVERY
+
+        try:
+            with self._lock:
+                snapshot = list(self._ops)
+                lo = self._checked
+                mask = self._mask
+            try:
+                enc = enc_mod.encode(self._model, History(snapshot))
+            except enc_mod.EncodingError:
+                return settle("unsupported")
+            if enc.n_states > 32:
+                return settle("unsupported")
+            if mask is None:
+                mask = 1 << enc.init_state
+            cuts = [int(c) for c in wgl.valid_cut_points(enc)
+                    if c > lo]
+            if not cuts:
+                return settle()
+            # furthest valid cut within the preferred segment length;
+            # when none lands inside it, the NEAREST cut beyond wins —
+            # never a non-cut boundary, which would let ops span the
+            # segment edge and fabricate a tentative-invalid
+            within = [c for c in cuts if c <= lo + self.MAX_SEG]
+            hi = within[-1] if within else cuts[0]
+            if hi - lo < self.STREAM_EVERY // 2 and hi < enc.m:
+                return settle()  # too little new work to launch for
+            seg = enc.segment(lo, hi)
+            states = [s for s in range(enc.n_states)
+                      if (mask >> s) & 1]
+            if not states:
+                return settle()
+            # ONE Encoded shared across the start-state rows:
+            # check_slices dedupes by identity, so the segment's
+            # tensors are packed once however many states are live
+            # (the row carries s)
+            items = [self.scheduler.submit(
+                "slice", self.tenant, self.run, (seg, s))
+                for s in states]
+        except Exception:  # noqa: BLE001 — streaming is advisory
+            logger.exception("streaming step failed")
+            return settle("unknown")
+        self._collect(items, lo, hi, enc.m)
+
+    def _collect(self, items: list[WorkItem], lo: int, hi: int,
+                 total_m: int) -> None:
+        new_mask = 0
+        unknown = False
+        for i in items:
+            i.done.wait(timeout=120)
+            r = i.result or {}
+            if not i.done.is_set() or r.get("unknown"):
+                unknown = True
+            new_mask |= int(r.get("mask") or 0)
+        with self._lock:
+            self._inflight = False
+            if self._state != "streaming":
+                return
+            if unknown:
+                # the device couldn't decide this segment: streaming
+                # stops tightening; the final check stays authoritative
+                self._state = "unknown"
+                return
+            self._mask = new_mask
+            self._checked = hi
+            self._frac = hi / max(total_m, 1)
+            if new_mask == 0:
+                # no state survives the prefix: the full history can
+                # never linearize — the verdict tightened to invalid
+                # BEFORE the stream even finished
+                self._state = "tentative-invalid"
+                telemetry.count("fleet.stream.tentative-invalid")
+        telemetry.count("fleet.stream.segments")
+        with self._lock:
+            pending = (self._state == "streaming"
+                       and self._since >= self.STREAM_EVERY)
+        if pending:
+            # ops that arrived while this segment was in flight kept
+            # their due-credit: check them now, don't wait for more
+            self.step()

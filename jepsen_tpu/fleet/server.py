@@ -1,0 +1,623 @@
+"""The fleet server: a persistent, crash-safe, multi-tenant checking
+service.
+
+Lifecycle of one tenant stream:
+
+  hello   admission control decides: admitted streams get a resume
+          point (last journaled seq, so a reconnecting client re-sends
+          only what the crash lost); saturated quotas get a `reject`
+          with retry-after — NEW streams are shed, in-flight ones are
+          never degraded
+  chunk   the frame is CRC-checked by the wire layer, journaled to the
+          run's WAL, and only THEN acked — the ack is a durability
+          promise a SIGKILL cannot revoke
+  fin     the completed history is submitted to the scheduler, which
+          packs it with other tenants' work into shared device
+          launches; the verdict (with its certificate) is written
+          atomically to the verdict file, then sent
+  claim   a reconnecting client (or a cold CLI) waits for / fetches an
+          already-computed verdict
+
+Crash recovery (`recover()`, run at every start): the WAL directory is
+the source of truth. Runs with a journaled fin and no verdict file are
+re-submitted; runs mid-stream restore their resume point and keep
+accepting chunks. Because verdict serialization is deterministic and
+analysis is seeded by the journaled bytes alone, a replayed verdict
+file is byte-identical to the one the crash interrupted.
+
+kill() is the test hook for SIGKILL-equivalence: it abandons all
+state without flushing or handshaking (WAL appends are already on
+disk per-ack, which is the point).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import socket
+import threading
+import time
+from pathlib import Path
+
+from .. import telemetry
+from ..history import History
+from . import elle_checks, wgl_models, wire
+from . import scheduler as fsched
+from . import wal as fwal
+
+logger = logging.getLogger(__name__)
+
+ADDR_FILE = "fleet.addr"
+VERDICT_TIMEOUT_S = 300.0
+# an unfinished run with no live connection and no ingest for this
+# long is abandoned: it stops counting against the tenant quota (its
+# WAL stays — a late reconnect still resumes it)
+ABANDONED_S = 900.0
+
+
+class Quotas:
+    """Admission-control knobs (doc/fleet.md). Defaults size the demo
+    pool: 8 concurrent tenants, so the 9th is REJECTED (with
+    retry-after) rather than letting overload degrade anyone already
+    admitted."""
+
+    def __init__(self, max_tenants: int = 8,
+                 max_streams_per_tenant: int = 4,
+                 max_total_streams: int = 16,
+                 max_ops_per_run: int = 2_000_000,
+                 retry_after_s: float = 2.0):
+        self.max_tenants = max_tenants
+        self.max_streams_per_tenant = max_streams_per_tenant
+        self.max_total_streams = max_total_streams
+        self.max_ops_per_run = max_ops_per_run
+        self.retry_after_s = retry_after_s
+
+
+class RunState:
+    """One (tenant, run) stream. Ingest (chunk/fin) is serialized
+    under `lock` — reconnects may hand the run to a new handler thread
+    while a half-dead one lingers, and the WAL append + seq advance
+    must stay atomic."""
+
+    _guarded_by_lock = {"lock": ("last_seq", "n_ops", "fin",
+                                 "verdict", "wal")}
+
+    def __init__(self, tenant: str, run: str, model: str,
+                 wal: fwal.RunWAL | None, stream=None, initial=None):
+        self.tenant = tenant
+        self.run = run
+        self.model = model
+        self.initial = initial
+        self.wal = wal  # None once complete (no fd squatting)
+        self.stream = stream  # StreamingRun | None
+        self.lock = threading.Lock()
+        self.last_seq = 0
+        self.n_ops = 0
+        self.fin = False
+        self.touched = time.monotonic()  # last hello/ingest
+        self.verdict: dict | None = None
+        self.verdict_ready = threading.Event()
+
+    def retire_wal(self) -> None:
+        """Closes the WAL fd once the run can never append again (fin
+        + verdict): a long-lived server over thousands of past runs
+        must not hold one fd per historical run."""
+        with self.lock:
+            wal, self.wal = self.wal, None
+        if wal is not None:
+            wal.close()
+
+
+def prometheus_from_stats(st: dict) -> str:
+    """Prometheus text exposition of a fleet stats dict — per-tenant
+    labels on the tenant series, appended to the web /metrics scrape
+    (which fetches the stats over the wire, so a scraper needs no
+    in-process server handle)."""
+    lines = []
+
+    def g(name, value, labels=""):
+        lines.append(f"jepsen_fleet_{name}{labels} {value}")
+
+    for k in ("accepted", "rejected", "chunks", "ops", "verdicts",
+              "recovered", "frame_errors", "runs", "active_streams"):
+        g(k, st.get(k, 0))
+    sch = st.get("scheduler") or {}
+    for k in ("launches", "items", "slice_rows", "final_hists",
+              "cross_tenant_launches", "pending"):
+        g(f"scheduler_{k}", sch.get(k, 0))
+    for tenant, ts in sorted((st.get("tenants") or {}).items()):
+        lab = '{tenant="%s"}' % tenant
+        for k in ("streams", "chunks", "ops", "verdicts",
+                  "rejected"):
+            g(f"tenant_{k}", ts.get(k, 0), lab)
+    return "\n".join(lines) + "\n"
+
+
+class FleetServer:
+    _guarded_by_lock = {"_lock": ("_runs", "_active", "_stats",
+                                  "_conns")}
+
+    def __init__(self, base, host: str = "127.0.0.1", port: int = 0,
+                 quotas: Quotas | None = None,
+                 scheduler: fsched.Scheduler | None = None,
+                 stream_checks: bool = True):
+        self.base = Path(base)
+        self.host = host
+        self.port = port
+        self.quotas = quotas if quotas is not None else Quotas()
+        self.scheduler = scheduler if scheduler is not None \
+            else fsched.Scheduler()
+        self.stream_checks = stream_checks
+        self._lock = threading.Lock()
+        self._runs: dict[tuple[str, str], RunState] = {}
+        self._active: dict[tuple[str, str], int] = {}  # open streams
+        self._stats: dict = {"accepted": 0, "rejected": 0,
+                             "chunks": 0, "ops": 0, "verdicts": 0,
+                             "recovered": 0, "frame_errors": 0,
+                             "tenants": {}}
+        self._sock: socket.socket | None = None
+        self._conns: set = set()  # accepted sockets (for kill/stop)
+        self._accept_thread: threading.Thread | None = None
+        self._stopping = threading.Event()
+        self._killed = False
+
+    # -- lifecycle -------------------------------------------------------
+
+    @property
+    def addr(self) -> tuple[str, int]:
+        assert self._sock is not None, "server not started"
+        return self._sock.getsockname()[:2]
+
+    def start(self) -> "FleetServer":
+        self.base.mkdir(parents=True, exist_ok=True)
+        self.recover()
+        self.scheduler.start()
+        s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        # a restarted server must re-bind its advertised port while
+        # the killed instance's connections are still draining
+        # (FIN_WAIT sockets held open by clients that are about to
+        # reconnect); REUSEADDR alone doesn't cover those on Linux
+        if hasattr(socket, "SO_REUSEPORT"):
+            try:
+                s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEPORT,
+                             1)
+            except OSError:  # pragma: no cover — platform quirk
+                pass
+        s.bind((self.host, self.port))
+        s.listen(64)
+        self._sock = s
+        self._stopping.clear()
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="fleet-accept", daemon=True)
+        self._accept_thread.start()
+        host, port = self.addr
+        (self.base / ADDR_FILE).write_text(
+            f"{host}:{port}\n{os.getpid()}\n")
+        logger.info("fleet server on %s:%d (base %s)", host, port,
+                    self.base)
+        return self
+
+    def stop(self) -> None:
+        """Graceful: stop accepting, let the scheduler drain, retire
+        the addr file."""
+        self._stopping.set()
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+        self.scheduler.stop()
+        self._close_conns()
+        with self._lock:
+            runs = list(self._runs.values())
+        for r in runs:
+            if r.wal is not None:
+                r.wal.close()
+        try:
+            (self.base / ADDR_FILE).unlink()
+        except OSError:
+            pass
+
+    def kill(self) -> None:
+        """SIGKILL-equivalent for tests: abandon everything. No WAL
+        flush (appends are already on disk — that's the contract), no
+        scheduler drain, no addr-file cleanup, connections die
+        mid-frame (a killed process's fds ALL close — and the port
+        must be immediately re-bindable by the restarted server)."""
+        self._killed = True
+        self._stopping.set()
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+        self._close_conns()
+        # scheduler threads are daemons; in-flight items are abandoned
+        # exactly as a real SIGKILL would abandon them
+        self.scheduler._stop.set()
+
+    def _close_conns(self) -> None:
+        with self._lock:
+            conns = list(self._conns)
+            self._conns.clear()
+        for c in conns:
+            try:
+                c.close()
+            except OSError:
+                pass
+
+    # -- crash recovery --------------------------------------------------
+
+    def recover(self) -> int:
+        """Replays every WAL under the base dir; re-submits finished
+        runs that never got their verdict written. Returns how many
+        verdicts were re-scheduled."""
+        n = 0
+        for tenant, run, path in fwal.scan_runs(self.base):
+            folded = fwal.replay(path)
+            hello = folded["hello"] or {}
+            model = hello.get("model", "cas-register")
+            verdict = fwal.read_verdict(self.base, tenant, run)
+            # complete runs are served from their verdict file: no
+            # appends can ever happen, so no WAL fd is held (a long
+            # base dir of past runs must not exhaust the fd table)
+            wal = None if verdict is not None else fwal.RunWAL(path)
+            rs = RunState(tenant, run, model, wal,
+                          initial=hello.get("initial"))
+            rs.last_seq = folded["last_seq"]
+            rs.n_ops = sum(len(o) for o in folded["chunks"].values())
+            rs.fin = folded["fin"] is not None
+            if verdict is not None:
+                rs.verdict = verdict
+                rs.verdict_ready.set()
+            with self._lock:
+                self._runs[(tenant, run)] = rs
+            if rs.fin and verdict is None:
+                ops = fwal.replay_ops(folded)
+                self._submit_final(rs, ops)
+                n += 1
+                with self._lock:
+                    self._stats["recovered"] += 1
+        if n:
+            logger.info("fleet recovery: re-scheduled %d verdict(s)",
+                        n)
+        return n
+
+    # -- stats / metrics -------------------------------------------------
+
+    def _tstat_locked(self, tenant: str) -> dict:
+        t = self._stats["tenants"].get(tenant)
+        if t is None:
+            t = self._stats["tenants"][tenant] = {
+                "streams": 0, "chunks": 0, "ops": 0, "verdicts": 0,
+                "rejected": 0}
+        return t
+
+    def stats(self) -> dict:
+        with self._lock:
+            out = {k: (dict(v) if isinstance(v, dict) else v)
+                   for k, v in self._stats.items()}
+            out["tenants"] = {t: dict(s)
+                              for t, s in self._stats["tenants"].items()}
+            out["runs"] = len(self._runs)
+            out["active_streams"] = sum(self._active.values())
+            streams = [(f"{t}/{r}", rs.stream)
+                       for (t, r), rs in self._runs.items()]
+        # snapshot first: a handler may null rs.stream concurrently
+        streaming = {k: s.status() for k, s in streams
+                     if s is not None}
+        out["streams"] = streaming
+        out["scheduler"] = self.scheduler.stats()
+        return out
+
+    def prometheus_text(self) -> str:
+        return prometheus_from_stats(self.stats())
+
+    # -- accept / connection handling ------------------------------------
+
+    def _accept_loop(self) -> None:
+        while not self._stopping.is_set():
+            try:
+                conn, _peer = self._sock.accept()
+            except OSError:
+                return  # closed
+            threading.Thread(target=self._handle, args=(conn,),
+                             name="fleet-conn", daemon=True).start()
+
+    def _handle(self, conn: socket.socket) -> None:
+        conn.settimeout(60.0)
+        with self._lock:
+            self._conns.add(conn)
+        rs: RunState | None = None
+        streams_key = None
+        try:
+            wire.recv_magic(conn)
+            while not self._stopping.is_set():
+                msg = wire.recv_msg(conn)
+                t = msg["type"]
+                if t == "hello" and msg.get("observe"):
+                    # an observer (status CLI, the web scraper): no
+                    # admission, no run state, no WAL — just a live
+                    # socket for status/claim-free queries
+                    wire.send_msg(conn, {"type": "helloed",
+                                         "last_seq": 0})
+                elif t == "hello":
+                    rs, streams_key = self._hello(conn, msg,
+                                                  streams_key)
+                    if rs is None:
+                        return  # rejected (reply already sent)
+                elif t == "status":
+                    wire.send_msg(conn, {"type": "stats",
+                                         "stats": self.stats()})
+                elif rs is None:
+                    wire.send_msg(conn, {"type": "error",
+                                         "reason": "hello first"})
+                    return
+                elif t == "chunk":
+                    self._chunk(conn, rs, msg)
+                elif t == "fin":
+                    self._fin(conn, rs, msg)
+                elif t == "claim":
+                    self._claim(conn, rs)
+                else:
+                    wire.send_msg(conn, {"type": "error",
+                                         "reason": f"bad type {t!r}"})
+                    return
+        except wire.FrameError:
+            # torn/corrupt frame or dead peer: the client's retry
+            # layer resyncs from its acked seq on a fresh connection
+            with self._lock:
+                self._stats["frame_errors"] += 1
+            telemetry.count("fleet.frame-errors")
+        except Exception:  # noqa: BLE001 — one conn never kills the server
+            logger.exception("fleet connection handler failed")
+        finally:
+            with self._lock:
+                self._conns.discard(conn)
+                if streams_key is not None:
+                    n = self._active.get(streams_key, 0)
+                    if n <= 1:
+                        self._active.pop(streams_key, None)
+                    else:
+                        self._active[streams_key] = n - 1
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    # -- message handlers ------------------------------------------------
+
+    def _hello(self, conn, msg, prev_key):
+        tenant = msg.get("tenant")
+        run = msg.get("run")
+        model = msg.get("model", "cas-register")
+        initial = msg.get("initial")
+        if initial is not None and not isinstance(
+                initial, (int, float, str, bool)):
+            wire.send_msg(conn, {"type": "reject",
+                                 "reason": "initial must be a JSON "
+                                           "scalar",
+                                 "retry_after": None})
+            return None, prev_key
+        if not (fwal.safe_name(tenant) and fwal.safe_name(run)):
+            wire.send_msg(conn, {"type": "reject",
+                                 "reason": "bad tenant/run name",
+                                 "retry_after": None})
+            return None, prev_key
+        if model not in wgl_models() and model not in elle_checks():
+            wire.send_msg(conn, {"type": "reject",
+                                 "reason": f"unknown model {model!r}",
+                                 "retry_after": None})
+            return None, prev_key
+        key = (tenant, run)
+        with self._lock:
+            rs = self._runs.get(key)
+            resuming = rs is not None
+            if not resuming:
+                # admission control: shed NEW streams only
+                reason = self._admit_locked(tenant)
+                if reason is not None:
+                    self._stats["rejected"] += 1
+                    self._tstat_locked(tenant)["rejected"] += 1
+                    telemetry.count("fleet.rejected")
+                    wire.send_msg(
+                        conn, {"type": "reject", "reason": reason,
+                               "retry_after":
+                                   self.quotas.retry_after_s})
+                    return None, prev_key
+            if prev_key != key:
+                if prev_key is not None:  # re-hello moved streams
+                    n = self._active.get(prev_key, 0)
+                    if n <= 1:
+                        self._active.pop(prev_key, None)
+                    else:
+                        self._active[prev_key] = n - 1
+                self._active[key] = self._active.get(key, 0) + 1
+            self._stats["accepted"] += 1
+            ts = self._tstat_locked(tenant)
+            if not resuming:
+                ts["streams"] += 1
+        if rs is None:
+            weight = msg.get("weight")
+            with self._lock:
+                # WAL creation + registration are ONE atomic step:
+                # two racing first-hellos must not both see a fresh
+                # file (each would append its own magic, corrupting
+                # every later record) — only the registration winner
+                # opens the WAL, so there is exactly one creator
+                rs = self._runs.get(key)
+                if rs is None:
+                    wal = fwal.RunWAL(
+                        fwal.wal_path(self.base, tenant, run))
+                    stream = None
+                    if self.stream_checks and model in wgl_models():
+                        stream = fsched.StreamingRun(
+                            model, self.scheduler, tenant, run,
+                            initial=initial)
+                    rs = RunState(tenant, run, model, wal, stream,
+                                  initial=initial)
+                    hello_rec = {"t": "hello", "tenant": tenant,
+                                 "run": run, "model": model,
+                                 "weight": weight or 1.0}
+                    if initial is not None:
+                        hello_rec["initial"] = initial
+                    rs.wal.append(hello_rec)
+                    self._runs[key] = rs
+            if isinstance(weight, (int, float)) and weight > 0:
+                self.scheduler.set_weight(tenant, weight)
+        rs.touched = time.monotonic()
+        reply = {"type": "helloed", "last_seq": rs.last_seq}
+        if rs.verdict is not None:
+            reply["verdict"] = rs.verdict
+        wire.send_msg(conn, reply)
+        return rs, key
+
+    def _admit_locked(self, tenant: str) -> str | None:
+        """Reason to reject, or None to admit. Caller holds _lock.
+        A tenant counts against the tenant quota while it has live
+        connections or runs still awaiting their verdict — finished
+        tenants age out, they don't squat the pool forever."""
+        q = self.quotas
+        now = time.monotonic()
+        tenants = {t for (t, _r), n in self._active.items() if n} | \
+            {t for (t, _r), rs in self._runs.items()
+             if not rs.verdict_ready.is_set()
+             and now - rs.touched < ABANDONED_S}
+        total = sum(self._active.values())
+        if tenant not in tenants and len(tenants) >= q.max_tenants:
+            return (f"tenant quota saturated "
+                    f"({q.max_tenants} tenants)")
+        if total >= q.max_total_streams:
+            return f"stream quota saturated ({total} streams)"
+        per = sum(n for (t, _r), n in self._active.items()
+                  if t == tenant)
+        if per >= q.max_streams_per_tenant:
+            return (f"per-tenant stream quota saturated "
+                    f"({per} streams)")
+        return None
+
+    def _chunk(self, conn, rs: RunState, msg) -> None:
+        seq = msg.get("seq")
+        ops = msg.get("ops")
+        if not isinstance(seq, int) or seq < 1 \
+                or not isinstance(ops, list):
+            wire.send_msg(conn, {"type": "error",
+                                 "reason": "malformed chunk"})
+            return
+        with rs.lock:
+            if rs.fin:
+                wire.send_msg(conn, {"type": "error",
+                                     "reason": "stream finished"})
+                return
+            if seq <= rs.last_seq:
+                # duplicate (retransmit after a lost ack, or a chaos
+                # duplicate): idempotent re-ack, no re-journal
+                wire.send_msg(conn, {"type": "ack",
+                                     "seq": rs.last_seq})
+                return
+            if seq > rs.last_seq + 1:
+                # gap (reordered frame): don't journal out of order —
+                # re-ack the resume point so the client rewinds
+                wire.send_msg(conn, {"type": "ack",
+                                     "seq": rs.last_seq,
+                                     "resync": True})
+                return
+            if rs.n_ops + len(ops) > self.quotas.max_ops_per_run:
+                wire.send_msg(
+                    conn, {"type": "reject",
+                           "reason": "run op quota exceeded",
+                           "retry_after": None})
+                return
+            if rs.wal is None:
+                # completed/retired run: nothing may append
+                wire.send_msg(conn, {"type": "error",
+                                     "reason": "stream finished"})
+                return
+            # WAL BEFORE ack: the ack promises durability
+            rs.wal.append({"t": "chunk", "seq": seq, "ops": ops})
+            rs.last_seq = seq
+            rs.n_ops += len(ops)
+            rs.touched = time.monotonic()
+            if rs.stream is not None:
+                # under rs.lock so a half-dead old handler racing a
+                # reconnected one can't feed the stream out of order
+                # (add_ops is cheap: the encode runs on the stream's
+                # own worker thread, never on this ack path)
+                try:
+                    rs.stream.add_ops(wire.ops_from_wire(ops))
+                except Exception:  # noqa: BLE001 — streaming is
+                    logger.exception("streaming check failed")
+                    rs.stream = None  # advisory; final check stays
+        with self._lock:
+            self._stats["chunks"] += 1
+            self._stats["ops"] += len(ops)
+            ts = self._tstat_locked(rs.tenant)
+            ts["chunks"] += 1
+            ts["ops"] += len(ops)
+        telemetry.count("fleet.chunks")
+        wire.send_msg(conn, {"type": "ack", "seq": seq})
+
+    def _fin(self, conn, rs: RunState, msg) -> None:
+        with rs.lock:
+            chunks = msg.get("chunks")
+            if isinstance(chunks, int) and chunks != rs.last_seq:
+                # the client believes it sent more than we journaled:
+                # NOT a completed stream — make it rewind and re-send
+                wire.send_msg(conn, {"type": "ack",
+                                     "seq": rs.last_seq,
+                                     "resync": True})
+                return
+            first_fin = not rs.fin and rs.wal is not None
+            if first_fin:
+                rs.wal.append({"t": "fin", "chunks": rs.last_seq})
+                rs.fin = True
+        if first_fin:
+            folded = fwal.replay(fwal.wal_path(self.base, rs.tenant,
+                                               rs.run))
+            self._submit_final(rs, fwal.replay_ops(folded))
+        self._claim(conn, rs)
+
+    def _submit_final(self, rs: RunState, ops: list) -> None:
+        engine = "wgl" if rs.model in wgl_models() else "elle"
+        item = self.scheduler.submit(
+            "final", rs.tenant, rs.run,
+            {"engine": engine, "model": rs.model,
+             "initial": rs.initial, "history": History(ops)})
+        threading.Thread(target=self._await_verdict, args=(rs, item),
+                         name=f"fleet-verdict-{rs.tenant}-{rs.run}",
+                         daemon=True).start()
+
+    def _await_verdict(self, rs: RunState, item) -> None:
+        item.done.wait(timeout=VERDICT_TIMEOUT_S)
+        result = item.result if item.done.is_set() else \
+            {"valid?": "unknown", "error": "fleet verdict timeout"}
+        # NOTE: nothing timing-dependent goes in here — the verdict
+        # file must replay byte-identical after a crash (the streaming
+        # status is live telemetry; it rides in stats(), not here)
+        verdict = {"tenant": rs.tenant, "run": rs.run,
+                   "model": rs.model, "n_ops": rs.n_ops,
+                   "result": fwal.json_safe(result)}
+        try:
+            fwal.write_verdict(self.base, rs.tenant, rs.run, verdict)
+        except OSError:
+            logger.exception("writing verdict file failed")
+        with rs.lock:
+            rs.verdict = verdict
+        rs.verdict_ready.set()
+        rs.retire_wal()  # the run can never append again
+        with self._lock:
+            self._stats["verdicts"] += 1
+            self._tstat_locked(rs.tenant)["verdicts"] += 1
+        telemetry.count("fleet.verdicts")
+
+    def _claim(self, conn, rs: RunState) -> None:
+        deadline = time.monotonic() + VERDICT_TIMEOUT_S
+        while time.monotonic() < deadline \
+                and not self._stopping.is_set():
+            if rs.verdict_ready.wait(timeout=1.0):
+                wire.send_msg(conn, {"type": "verdict",
+                                     "result": rs.verdict})
+                return
+        wire.send_msg(conn, {"type": "error",
+                             "reason": "verdict not ready"})

@@ -32,6 +32,7 @@ the shared crash-tolerance contract of the repo's jsonl artifacts.
 from __future__ import annotations
 
 import json
+import os
 import time
 from pathlib import Path
 
@@ -69,13 +70,44 @@ def read_entries(path) -> list[dict]:
 
 
 def append_entry(path, entry: dict) -> dict:
-    """Appends one round's entry (ts stamped if absent); returns it."""
+    """Appends one round's entry (ts stamped if absent); returns it.
+    The append is ONE os.write on an O_APPEND fd — the jlog
+    concurrent-append discipline: two writers (a bench round racing a
+    fleet server's bookkeeping, or two bench invocations) can
+    interleave LINES but never bytes, so readers at worst drop a torn
+    trailing line, never mis-parse a spliced one."""
     entry = dict(entry)
     entry.setdefault("ts", round(time.time(), 3))
-    with open(path, "a") as f:
-        f.write(json.dumps(entry))
-        f.write("\n")
+    atomic_append_line(path, json.dumps(entry))
     return entry
+
+
+def write_all(fd: int, buf: bytes) -> None:
+    """os.write until every byte lands, raising on a zero-progress
+    write — the one short-write loop shared by every crash-safe
+    append in the repo (this module, the coverage atlas, the fleet
+    WAL). A silently-torn record behind a durability promise is the
+    failure mode this exists to kill."""
+    view = memoryview(buf)
+    while view:
+        n = os.write(fd, view)
+        if n <= 0:
+            raise OSError("short write")
+        view = view[n:]
+
+
+def atomic_append_line(path, line: str) -> None:
+    """One whole line, one os.write, O_APPEND: the shared-ledger
+    append primitive (used by this module and the coverage atlas).
+    Short writes (ENOSPC, signals) are continued rather than silently
+    torn — the continuation can interleave with another writer only
+    in the already-degraded disk-full case, where the torn-tail read
+    rule still drops the damage."""
+    fd = os.open(path, os.O_APPEND | os.O_CREAT | os.O_WRONLY, 0o644)
+    try:
+        write_all(fd, (line + "\n").encode())
+    finally:
+        os.close(fd)
 
 
 def next_round(entries: list[dict], floor: int = 0) -> int:

@@ -37,7 +37,7 @@ logger = logging.getLogger(__name__)
 _LIFECYCLE_KEYS = ("client", "generator", "final_generator", "nemesis",
                    "db", "os", "remote", "sessions", "barrier",
                    "history_writer", "monitor", "watchdog", "net",
-                   "nodeprobe")
+                   "nodeprobe", "_fleet_streamer", "fleet")
 
 
 def recover_history(d):

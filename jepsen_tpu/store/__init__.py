@@ -55,7 +55,7 @@ _SKIP_KEYS = {"history", "results", "barrier", "db", "client", "nemesis",
               "checker", "generator", "os", "remote", "sessions",
               "history_writer", "store_dir", "_log_handler",
               "monitor", "watchdog", "monitor_probes", "health",
-              "nodeprobe"}
+              "nodeprobe", "_fleet_streamer"}
 
 
 def base_dir(test: dict | None = None) -> Path:

@@ -14,6 +14,7 @@ SUITES = {
     "aerospike": "jepsen_tpu.suites.aerospike",
     "cockroach": "jepsen_tpu.suites.cockroach",
     "consul": "jepsen_tpu.suites.consul",
+    "crate": "jepsen_tpu.suites.crate",
     "dgraph": "jepsen_tpu.suites.dgraph",
     "disque": "jepsen_tpu.suites.disque",
     "elasticsearch": "jepsen_tpu.suites.elasticsearch",
@@ -24,6 +25,7 @@ SUITES = {
     "postgres": "jepsen_tpu.suites.postgres",
     "rabbitmq": "jepsen_tpu.suites.rabbitmq",
     "raftis": "jepsen_tpu.suites.raftis",
+    "redis-sentinel": "jepsen_tpu.suites.redis_sentinel",
     "rethinkdb": "jepsen_tpu.suites.rethinkdb",
     "stolon": "jepsen_tpu.suites.stolon",
     "tidb": "jepsen_tpu.suites.tidb",

@@ -969,6 +969,60 @@ def check_batch_reach(encs: Sequence[Encoded], W: int = 32,
             np.ones(len(encs), dtype=bool))
 
 
+def check_slices(slices: Sequence[tuple[Encoded, int]],
+                 W: int = 24, F: int = 48
+                 ) -> tuple[np.ndarray, np.ndarray]:
+    """The fleet's cross-run batching entry point: packs (encoded
+    slice, start state) rows from MANY tenants' streams into ONE reach
+    launch (jepsen_tpu.fleet.scheduler — continuous batching, as in
+    inference serving). Distinct rows may share an Encoded (one
+    segment searched from several live start states costs one packed
+    history, several rows), so slices dedupe by identity before
+    packing. Returns (out_mask uint32 [len(slices)], unknown bool
+    [len(slices)]), row i answering slices[i]. Requires every
+    n_states <= 32 (reach packs states into a uint32).
+
+    Device failures walk the same ladder as check_batch_reach —
+    smaller launches, then the HOST floor, which here computes real
+    per-row masks (search_host_reach) instead of all-unknown: a fleet
+    under device pressure gets slower, never less decisive."""
+    slices = list(slices)
+    if not slices:
+        return (np.empty(0, dtype=np.uint32),
+                np.empty(0, dtype=bool))
+    assert max(e.n_states for e, _s in slices) <= 32, \
+        "reach mode packs states into a uint32"
+    encs: list[Encoded] = []
+    idx: dict[int, int] = {}
+    rows: list[tuple[int, int]] = []
+    for enc, s in slices:
+        j = idx.get(id(enc))
+        if j is None:
+            j = idx[id(enc)] = len(encs)
+            encs.append(enc)
+        rows.append((j, int(s)))
+    try:
+        pb = PackedBatch(encs)
+        out, unk = _drain(_launch(pb, rows, W, F, reach=True),
+                          reach=True)
+        return (np.asarray(out[:len(rows)], dtype=np.uint32),
+                np.asarray(unk[:len(rows)], dtype=bool))
+    except Exception as e:  # noqa: BLE001 — device ladder
+        kind = _ladder_classify(e, "slices kernel")
+    if kind != "compile" and len(slices) > 1:  # see check_batch
+        _ladder_note("batch-halved")
+        mid = len(slices) // 2
+        a_out, a_unk = check_slices(slices[:mid], W, F)
+        b_out, b_unk = check_slices(slices[mid:], W, F)
+        return (np.concatenate([a_out, b_out]),
+                np.concatenate([a_unk, b_unk]))
+    _ladder_note("host-floor")
+    out = np.fromiter(
+        (search_host_reach(e.with_init(s)) for e, s in slices),
+        dtype=np.uint32, count=len(slices))
+    return out, np.zeros(len(slices), dtype=bool)
+
+
 # ---------------------------------------------------------------------------
 # Segment-parallel checking of long histories
 # ---------------------------------------------------------------------------

@@ -249,10 +249,90 @@ def home_html(base: Path | None = None) -> str:
             "td, th { padding: 4px 10px; text-align: left }"
             "</style></head><body><h1>Jepsen</h1>"
             "<p><a href='/coverage/'>coverage atlas</a> · "
-            "<a href='/lint'>graftlint</a></p><table>"
+            "<a href='/lint'>graftlint</a> · "
+            "<a href='/fleet'>fleet</a></p><table>"
             "<tr><th>Test</th><th>Time</th><th>Valid?</th>"
             "<th colspan=5>Artifacts</th></tr>"
             + "".join(rows) + "</table></body></html>")
+
+
+def _fleet_stats(base: Path):
+    """(stats, addr) of the fleet server advertised under
+    <base>/fleet/fleet.addr, or (None, reason)."""
+    addr_file = Path(base or "store") / "fleet" / "fleet.addr"
+    try:
+        addr = addr_file.read_text().splitlines()[0].strip()
+    except (OSError, IndexError):
+        return None, "no fleet server running (no fleet.addr)"
+    try:
+        from .fleet.client import FleetClient
+
+        from .control.retry import RetryBudget
+
+        # one short attempt, no retries: a stale fleet.addr pointing
+        # at a hung host must not stall every /metrics scrape
+        c = FleetClient(addr, "web", "status", io_timeout_s=3.0,
+                        observe=True, connect_timeout_s=1.5,
+                        budget=RetryBudget(0))
+        st = c.status()
+        c.close()
+        return st, addr
+    except Exception as e:  # noqa: BLE001 — stale addr file etc.
+        return None, f"fleet at {addr} unreachable: {e}"
+
+
+def fleet_html(base: Path | None = None) -> str:
+    """The fleet status page: service counters, per-tenant quota use,
+    live streaming-check state, scheduler batching stats
+    (jepsen_tpu.fleet; doc/fleet.md)."""
+    st, info = _fleet_stats(base or Path("store"))
+    head = ("<!DOCTYPE html><html><head><meta charset='utf-8'>"
+            "<title>fleet</title><style>"
+            "body { font-family: sans-serif } "
+            "table { border-collapse: collapse; margin: 8px 0 } "
+            "td, th { padding: 3px 10px; text-align: left; "
+            "border-bottom: 1px solid #ddd }"
+            "</style></head><body><h1>analysis fleet</h1>"
+            "<p><a href='/'>&larr; runs</a></p>")
+    if st is None:
+        return (head + f"<p><em>{_html.escape(str(info))}</em></p>"
+                "<p>start one with <code>python -m jepsen_tpu fleet "
+                "serve</code></p></body></html>")
+    sch = st.get("scheduler") or {}
+    rows = "".join(
+        f"<tr><td>{_html.escape(t)}</td>"
+        + "".join(f"<td>{s.get(k, 0)}</td>"
+                  for k in ("streams", "chunks", "ops", "verdicts",
+                            "rejected"))
+        + "</tr>"
+        for t, s in sorted((st.get("tenants") or {}).items()))
+    streams = "".join(
+        f"<tr><td>{_html.escape(k)}</td>"
+        f"<td>{_html.escape(str(v.get('state')))}</td>"
+        f"<td>{v.get('checked-frac')}</td><td>{v.get('ops')}</td>"
+        f"</tr>"
+        for k, v in sorted((st.get("streams") or {}).items()))
+    return (head
+            + f"<p>server at <code>{_html.escape(str(info))}</code>"
+            f" · {st.get('runs', 0)} runs · "
+            f"{st.get('active_streams', 0)} active streams · "
+            f"{st.get('verdicts', 0)} verdicts · "
+            f"{st.get('rejected', 0)} rejected · "
+            f"{st.get('recovered', 0)} recovered</p>"
+            "<h2>scheduler</h2><p>"
+            + " · ".join(f"{k} {sch.get(k, 0)}" for k in
+                         ("launches", "items", "slice_rows",
+                          "final_hists", "cross_tenant_launches",
+                          "pending"))
+            + (" · <b>device breaker OPEN</b>"
+               if sch.get("breaker_open") else "")
+            + "</p><h2>tenants</h2><table><tr><th>tenant</th>"
+            "<th>streams</th><th>chunks</th><th>ops</th>"
+            "<th>verdicts</th><th>rejected</th></tr>" + rows
+            + "</table><h2>live streaming checks</h2>"
+            "<table><tr><th>tenant/run</th><th>state</th>"
+            "<th>checked-frac</th><th>ops</th></tr>" + streams
+            + "</table></body></html>")
 
 
 def anomaly_index(res, prefix: str = "", depth: int = 0) -> list:
@@ -896,6 +976,11 @@ class StoreHandler(BaseHTTPRequestHandler):
                                "application/json")
                 else:
                     self._send(200, lint_html(refresh).encode())
+            elif path == "/fleet" or path == "/fleet/":
+                # checking-as-a-service status (jepsen_tpu.fleet):
+                # reads <base>/fleet/fleet.addr and asks the live
+                # server for its per-tenant stats
+                self._send(200, fleet_html(self.base).encode())
             elif path == "/coverage" or path.startswith("/coverage/"):
                 # the cross-run fault × workload × anomaly heatmap
                 # (jepsen_tpu.coverage); /coverage/<fault>/<workload>
@@ -960,6 +1045,17 @@ class StoreHandler(BaseHTTPRequestHandler):
                         except Exception:  # noqa: BLE001
                             logger.exception(
                                 "coverage metrics failed")
+                        # fleet samples (per-tenant labels) ride on
+                        # the same scrape when a server is running
+                        try:
+                            from .fleet.server import \
+                                prometheus_from_stats
+
+                            st, _info = _fleet_stats(self.base)
+                            if st is not None:
+                                body += prometheus_from_stats(st)
+                        except Exception:  # noqa: BLE001
+                            logger.exception("fleet metrics failed")
                         self._send(
                             200, body.encode(),
                             "text/plain; version=0.0.4; "

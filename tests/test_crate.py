@@ -1,0 +1,270 @@
+"""CrateDB suite tests: DB config emission via the dummy remote, the
+_sql-over-curl reply handling, conditional-UPDATE CAS semantics, and
+clusterless end-to-end register runs (mirrors aphyr/jepsen
+crate/src/jepsen/crate.clj)."""
+
+import threading
+
+from jepsen_tpu import control, core, suites, testing
+from jepsen_tpu import generator as gen
+from jepsen_tpu.control.core import Action
+from jepsen_tpu.control.dummy import DummyRemote
+from jepsen_tpu.history import Op
+from jepsen_tpu.suites import crate as cr
+
+
+class TestRegistry:
+    def test_registered(self):
+        assert "crate" in suites.SUITES
+        assert suites.load("crate") is cr
+
+
+def _sql_responder(node, action):
+    """install_archive's probe commands + a success reply for the
+    schema-create curl on the primary (the disque test's responder
+    pattern)."""
+    from jepsen_tpu.control.core import Result
+
+    if action.cmd.startswith("curl"):
+        return '{"rows": [], "rowcount": 1}'
+    if action.cmd.startswith("stat "):
+        return Result(exit=1, out="", err="no such file",
+                      cmd=action.cmd)
+    if action.cmd.startswith("dirname "):
+        return action.cmd.split()[-1].rsplit("/", 1)[0]
+    if action.cmd.startswith("ls -A"):
+        return "crate-5.7.2"
+    return None
+
+
+class TestDB:
+    def test_setup_commands(self):
+        seen = []
+
+        def responder(node, action):
+            seen.append(action.cmd)
+            return _sql_responder(node, action)
+
+        remote = DummyRemote(responder)
+        nodes = ["n1", "n2", "n3"]
+        test = testing.noop_test()
+        test.update(nodes=nodes, remote=remote,
+                    sessions={n: remote.connect({"host": n})
+                              for n in nodes})
+        with control.with_session(test, "n1"):
+            cr.CrateDB("5.7.2").setup(test, "n1")
+        got = " ; ".join(seen)
+        assert "crate-5.7.2.tar.gz" in got
+        assert "-Cdiscovery.seed_hosts=n1:4300,n2:4300,n3:4300" in got
+        # the primary creates the schema with full replication (the
+        # schema curl runs on CrateSql's own session, hence the
+        # responder-side capture)
+        assert "CREATE TABLE IF NOT EXISTS jepsen_r" in got
+        assert "number_of_replicas = 2" in got
+
+    def test_non_primary_skips_schema(self):
+        remote = DummyRemote(_sql_responder)
+        nodes = ["n1", "n2"]
+        test = testing.noop_test()
+        test.update(nodes=nodes, remote=remote,
+                    sessions={n: remote.connect({"host": n})
+                              for n in nodes})
+        with control.with_session(test, "n2"):
+            cr.CrateDB().setup(test, "n2")
+        got = " ; ".join(a.cmd for a in test["sessions"]["n2"].log
+                         if isinstance(a, Action))
+        assert "CREATE TABLE" not in got
+
+
+class FakeCrate:
+    """An in-memory register speaking _sql JSON replies, including
+    the conditional-UPDATE rowcount contract."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.value = None
+
+    def stmt(self, sql, args=None):
+        args = args or []
+        s = sql.strip().upper()
+        with self.lock:
+            if s.startswith("REFRESH"):
+                return {"rows": [], "rowcount": 0}
+            if s.startswith("SELECT"):
+                rows = [] if self.value is None else [[self.value]]
+                return {"rows": rows, "rowcount": len(rows)}
+            if s.startswith("INSERT"):
+                self.value = int(args[0])
+                return {"rows": [], "rowcount": 1}
+            if s.startswith("UPDATE"):
+                to, frm = int(args[0]), int(args[1])
+                if self.value is not None and self.value == frm:
+                    self.value = to
+                    return {"rows": [], "rowcount": 1}
+                return {"rows": [], "rowcount": 0}
+            raise AssertionError(f"unexpected {sql}")
+
+
+class FakeSqlFactory:
+    def __init__(self, state=None):
+        self.state = state or FakeCrate()
+
+    def __call__(self, test, node, timeout=8.0):
+        state = self.state
+
+        class _C:
+            def stmt(self, sql, args=None):
+                return state.stmt(sql, args)
+
+            def close(self):
+                pass
+
+        return _C()
+
+
+def run_register(opts, factory):
+    w = cr.register_workload(opts)
+    w["client"].sql_factory = factory
+    test = testing.noop_test()
+    test.update(nodes=["n1", "n2"],
+                concurrency=opts.get("concurrency", 4),
+                client=w["client"], checker=w["checker"],
+                generator=gen.clients(
+                    gen.stagger(0.0004, w["generator"])))
+    return core.run(test)
+
+
+class TestEndToEnd:
+    def test_register_linearizable(self):
+        test = run_register({"ops": 150, "seed": 9},
+                            FakeSqlFactory())
+        assert test["results"]["valid?"] is True
+        assert test["results"]["anomaly-classes"][
+            "nonlinearizable"] == "clean"
+
+    def test_lost_update_detected(self):
+        class LostUpdates(FakeCrate):
+            """Every 4th acknowledged write silently reverts — the
+            version-divergence shape the reference analysis found."""
+
+            def __init__(self):
+                super().__init__()
+                self.writes = 0
+
+            def stmt(self, sql, args=None):
+                out = super().stmt(sql, args)
+                if sql.strip().upper().startswith("INSERT"):
+                    self.writes += 1
+                    if self.writes % 4 == 0:
+                        with self.lock:
+                            self.value = 97
+                return out
+
+        test = run_register({"ops": 200, "seed": 11},
+                            FakeSqlFactory(LostUpdates()))
+        assert test["results"]["valid?"] is False
+        assert test["results"]["anomaly-classes"][
+            "nonlinearizable"] == "witnessed"
+
+
+class TestClient:
+    def test_cas_rowcount_contract(self):
+        state = FakeCrate()
+        state.value = 2
+        c = cr.CrateRegisterClient(FakeSqlFactory(state)).open(
+            {}, "n1")
+        op = Op(index=0, time=0, type="invoke", process=0, f="cas",
+                value=[3, 4])
+        assert c.invoke({}, op).type == "fail"  # rowcount 0: definite
+        op2 = Op(index=0, time=0, type="invoke", process=0, f="cas",
+                 value=[2, 4])
+        assert c.invoke({}, op2).type == "ok"
+        assert state.value == 4
+
+    def test_sql_error_reply_is_definite_fail(self):
+        class Rejecting:
+            def __call__(self, test, node, timeout=8.0):
+                class _C:
+                    def stmt(self, sql, args=None):
+                        raise cr.CrateSqlError(
+                            "blocked by: [FORBIDDEN/12/index "
+                            "read-only]")
+
+                    def close(self):
+                        pass
+
+                return _C()
+
+        c = cr.CrateRegisterClient(Rejecting()).open({}, "n1")
+        op = Op(index=0, time=0, type="invoke", process=0, f="write",
+                value=1)
+        assert c.invoke({}, op).type == "fail"
+
+    def test_opaque_sql_error_on_write_is_indeterminate(self):
+        """An internal shard-failure error during a partition may
+        have applied on the primary — never a definite :fail (the
+        rethinkdb-suite classification rule)."""
+
+        class Opaque:
+            def __call__(self, test, node, timeout=8.0):
+                class _C:
+                    def stmt(self, sql, args=None):
+                        raise cr.CrateSqlError(
+                            "SQLActionException: shard failure, "
+                            "primary unavailable")
+
+                    def close(self):
+                        pass
+
+                return _C()
+
+        c = cr.CrateRegisterClient(Opaque()).open({}, "n1")
+        op = Op(index=0, time=0, type="invoke", process=0, f="write",
+                value=1)
+        assert c.invoke({}, op).type == "info"
+        # reads always fail safely
+        rd = Op(index=0, time=0, type="invoke", process=0, f="read",
+                value=None)
+        assert c.invoke({}, rd).type == "fail"
+
+    def test_transport_error_on_write_is_indeterminate(self):
+        class Dying:
+            def __call__(self, test, node, timeout=8.0):
+                class _C:
+                    def stmt(self, sql, args=None):
+                        from jepsen_tpu.control.core import \
+                            RemoteError
+
+                        raise RemoteError("timed out", exit=28,
+                                          out="", err="timed out",
+                                          cmd="curl", node=node)
+
+                    def close(self):
+                        pass
+
+                return _C()
+
+        c = cr.CrateRegisterClient(Dying()).open({}, "n1")
+        op = Op(index=0, time=0, type="invoke", process=0, f="write",
+                value=1)
+        assert c.invoke({}, op).type == "info"
+
+    def test_non_json_reply_raises_remote_error(self):
+        responder_out = []
+
+        def responder(node, action):
+            responder_out.append(action.cmd)
+            return "<html>502 bad gateway</html>"
+
+        remote = DummyRemote(responder)
+        test = testing.noop_test()
+        test.update(nodes=["n1"], remote=remote,
+                    sessions={"n1": remote.connect({"host": "n1"})})
+        with control.with_session(test, "n1"):
+            sql = cr.CrateSql(test, "n1")
+            import pytest
+
+            from jepsen_tpu.control.core import RemoteError
+
+            with pytest.raises(RemoteError):
+                sql.stmt("SELECT 1")

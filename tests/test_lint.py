@@ -461,7 +461,7 @@ class TestProductionSweep:
         assert production_report.errors == []
         traced = {t["kernel"] for t in production_report.traces}
         assert traced == {"wgl", "wgl-reach", "wgl-segmented",
-                          "wgl-sharded", "scc"}
+                          "wgl-sharded", "wgl-slices", "scc"}
 
     def test_baseline_gate(self, production_report):
         """THE tier-1 ratchet: a change that introduces a finding not

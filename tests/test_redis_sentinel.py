@@ -1,0 +1,231 @@
+"""Redis+Sentinel suite tests: DB config emission via the dummy
+remote, sentinel master discovery + READONLY re-resolution, CAS
+atomicity through a fake redis, and clusterless end-to-end register
+runs (mirrors aphyr/jepsen redis/src/jepsen/redis.clj)."""
+
+import threading
+
+from jepsen_tpu import control, core, suites, testing
+from jepsen_tpu import generator as gen
+from jepsen_tpu.control.core import Action
+from jepsen_tpu.control.dummy import DummyRemote
+from jepsen_tpu.history import Op
+from jepsen_tpu.suites import redis_sentinel as rs
+
+
+class TestRegistry:
+    def test_registered(self):
+        assert "redis-sentinel" in suites.SUITES
+        assert suites.load("redis-sentinel") is rs
+
+
+class TestDB:
+    def test_setup_commands(self):
+        remote = DummyRemote()
+        nodes = ["n1", "n2", "n3"]
+        test = testing.noop_test()
+        test.update(nodes=nodes, remote=remote,
+                    sessions={n: remote.connect({"host": n})
+                              for n in nodes})
+        db = rs.RedisSentinelDB()
+        with control.with_session(test, "n2"):
+            db.setup(test, "n2")
+        # config content travels as the write_file action's stdin
+        got = " ; ".join(f"{a.cmd} << {a.stdin or ''}"
+                         for a in test["sessions"]["n2"].log
+                         if isinstance(a, Action))
+        # a non-primary node replicates the first node
+        assert "replicaof n1 6379" in got
+        # the sentinel monitors the primary with a majority quorum
+        assert "sentinel monitor jepsen n1 6379 2" in got
+        assert "--sentinel" in got
+
+    def test_primary_gets_no_replicaof(self):
+        remote = DummyRemote()
+        nodes = ["n1", "n2", "n3"]
+        test = testing.noop_test()
+        test.update(nodes=nodes, remote=remote,
+                    sessions={n: remote.connect({"host": n})
+                              for n in nodes})
+        with control.with_session(test, "n1"):
+            rs.RedisSentinelDB().setup(test, "n1")
+        got = " ; ".join(f"{a.cmd} << {a.stdin or ''}"
+                         for a in test["sessions"]["n1"].log
+                         if isinstance(a, Action))
+        assert "replicaof" not in got
+
+
+class FakeRedis:
+    """One in-memory register speaking redis-cli reply strings, with
+    a scripted master address and optional READONLY bounces."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.value = None
+        self.master = ("n1", 6379)
+        self.readonly_bounces = 0  # bounce the next N writes
+
+    def cli(self, host, port, *args):
+        cmd = args[0].upper()
+        with self.lock:
+            if cmd == "SENTINEL":
+                return f"{self.master[0]}\n{self.master[1]}"
+            if cmd == "GET":
+                return "" if self.value is None else str(self.value)
+            if cmd in ("SET", "EVAL") and self.readonly_bounces > 0:
+                self.readonly_bounces -= 1
+                return ("READONLY You can't write against a read "
+                        "only replica.")
+            if cmd == "SET":
+                self.value = int(args[2])
+                return "OK"
+            if cmd == "EVAL":
+                frm, to = int(args[-2]), int(args[-1])
+                if self.value is not None and self.value == frm:
+                    self.value = to
+                    return "1"
+                return "0"
+            raise AssertionError(f"unexpected {args}")
+
+
+class FakeCliFactory:
+    def __init__(self, state=None):
+        self.state = state or FakeRedis()
+
+    def __call__(self, test, node, timeout=5.0):
+        state = self.state
+
+        class _C:
+            def __init__(self):
+                self.master = None
+
+            def resolve_master(self):
+                out = state.cli(node, 26379, "SENTINEL",
+                                "get-master-addr-by-name", "jepsen")
+                h, p = out.splitlines()
+                self.master = (h, int(p))
+                return self.master
+
+            def run(self, *args):
+                if self.master is None:
+                    self.resolve_master()
+                return state.cli(self.master[0], self.master[1],
+                                 *args)
+
+            def forget_master(self):
+                self.master = None
+
+            def close(self):
+                pass
+
+        return _C()
+
+
+def run_register(opts, factory):
+    w = rs.register_workload(opts)
+    w["client"].cli_factory = factory
+    test = testing.noop_test()
+    test.update(nodes=["n1", "n2"],
+                concurrency=opts.get("concurrency", 4),
+                client=w["client"], checker=w["checker"],
+                generator=gen.clients(
+                    gen.stagger(0.0004, w["generator"])))
+    return core.run(test)
+
+
+class TestEndToEnd:
+    def test_register_linearizable(self):
+        test = run_register({"ops": 150, "seed": 5},
+                            FakeCliFactory())
+        assert test["results"]["valid?"] is True
+        assert test["results"]["anomaly-classes"][
+            "nonlinearizable"] == "clean"
+
+    def test_failover_lost_write_detected(self):
+        class SplitBrain(FakeRedis):
+            """After the failover point every read returns 99 — a
+            value outside the write domain (0..4), i.e. state from a
+            diverged master no linearization can explain (the
+            synth.corrupt_register_history shape)."""
+
+            def __init__(self):
+                super().__init__()
+                self.calls = 0
+
+            def cli(self, host, port, *args):
+                with self.lock:
+                    self.calls += 1
+                    diverged = self.calls > 120
+                if diverged and args[0].upper() == "GET":
+                    return "99"
+                return super().cli(host, port, *args)
+
+        test = run_register({"ops": 200, "seed": 7},
+                            FakeCliFactory(SplitBrain()))
+        assert test["results"]["valid?"] is False
+        assert test["results"]["anomaly-classes"][
+            "nonlinearizable"] == "witnessed"
+
+
+class TestClient:
+    def test_readonly_bounce_reresolves_once(self):
+        state = FakeRedis()
+        state.readonly_bounces = 1
+        c = rs.SentinelRegisterClient(FakeCliFactory(state)).open(
+            {}, "n1")
+        op = Op(index=0, time=0, type="invoke", process=0, f="write",
+                value=4)
+        done = c.invoke({}, op)
+        # one bounce: re-resolve + retry succeeds, still ONE op
+        assert done.type == "ok"
+        assert state.value == 4
+
+    def test_persistent_readonly_is_definite_fail(self):
+        state = FakeRedis()
+        state.readonly_bounces = 99
+        c = rs.SentinelRegisterClient(FakeCliFactory(state)).open(
+            {}, "n1")
+        op = Op(index=0, time=0, type="invoke", process=0, f="write",
+                value=4)
+        done = c.invoke({}, op)
+        # a REFUSED write definitely did not apply
+        assert done.type == "fail"
+        assert state.value is None
+
+    def test_cas_precondition_fail_is_definite(self):
+        state = FakeRedis()
+        state.value = 2
+        c = rs.SentinelRegisterClient(FakeCliFactory(state)).open(
+            {}, "n1")
+        op = Op(index=0, time=0, type="invoke", process=0, f="cas",
+                value=[3, 4])
+        assert c.invoke({}, op).type == "fail"
+        op2 = Op(index=0, time=0, type="invoke", process=0, f="cas",
+                 value=[2, 4])
+        assert c.invoke({}, op2).type == "ok"
+        assert state.value == 4
+
+    def test_transport_error_on_write_is_indeterminate(self):
+        class Dying:
+            def __call__(self, test, node, timeout=5.0):
+                class _C:
+                    def run(self, *args):
+                        from jepsen_tpu.control.core import \
+                            RemoteError
+
+                        raise RemoteError("broken pipe", exit=1,
+                                          out="", err="broken pipe",
+                                          cmd="SET", node=node)
+
+                    def forget_master(self):
+                        pass
+
+                    def close(self):
+                        pass
+
+                return _C()
+
+        c = rs.SentinelRegisterClient(Dying()).open({}, "n1")
+        op = Op(index=0, time=0, type="invoke", process=0, f="write",
+                value=1)
+        assert c.invoke({}, op).type == "info"
