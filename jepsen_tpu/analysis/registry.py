@@ -26,18 +26,10 @@ from typing import Callable
 
 from ..tpu.lint import ArgSpec, KernelTrace
 
-# Arg names of the wgl kernel, in signature order (the jit factories
-# are positional; args_info comes back positional too).
-WGL_ARGS = ("inv_t", "ret_t", "trans", "mseg", "sufmin",
-            "row_seg", "st0")
-SCC_ARGS = ("active", "src", "dst", "edge_on")
-
-# The ensemble launch site's partition layout (ensemble._jitted_sharded
-# in_shardings): search rows shard over the 1-D 'b' mesh axis, segment
-# tensors are replicated — exactly what R4 prices.
-SHARDED_PARTITION = {"axis": "b", "sharded": ["row_seg", "st0"],
-                     "replicated": ["inv_t", "ret_t", "trans",
-                                    "mseg", "sufmin"]}
+# Arg names come from the kernel modules themselves
+# (ensemble.SHARD_ARGS, scc.SCC_ARGS — imported lazily in the trace
+# functions): one definition next to each signature, so the registry
+# can't hold a stale parallel copy of the layout it prices.
 
 
 def _provenance(fn) -> tuple[str | None, int | None]:
@@ -119,7 +111,20 @@ def _wgl_sds(b: dict):
     return (sds((K, M), np.int32), sds((K, M), np.int32),
             sds((K, M, S), np.int32), sds((K,), np.int32),
             sds((K, M + 1), np.int32), sds((rows,), np.int32),
-            sds((rows,), np.int32))
+            sds((rows,), np.int32), sds((rows,), np.int32))
+
+
+def _mesh1():
+    """A 1-device mesh: the sharded jit factories are the REAL launch
+    artifacts on any mesh size, and tracing them on one device keeps
+    the registry deterministic and CPU-safe (tier-1 runs this)."""
+    import numpy as np
+
+    import jax
+
+    from ..tpu import spmd
+
+    return jax.sharding.Mesh(np.array(jax.devices()[:1]), (spmd.AXIS,))
 
 
 def _staged(traced, full: bool):
@@ -133,21 +138,31 @@ def _staged(traced, full: bool):
 
 def _wgl_trace(b: dict, kernel_name: str,
                full: bool = False) -> KernelTrace:
-    from ..tpu import wgl
+    """Every wgl entry point launches through the SPMD program
+    (ensemble._jitted_sharded via wgl._launch) whenever the process
+    has >1 device, so THAT factory is what the registry traces: the
+    partition layout, donation flags and jaxpr are read off the
+    artifact the mesh actually runs. The partition metadata comes
+    from the same rule table the launch sites use (tpu/spmd.py) —
+    graftlint R4 prices the real layout, not a parallel description."""
+    from ..tpu import ensemble, spmd, wgl
 
-    kw = dict(W=b["W"], F=b["F"], max_iters=b["M"] + 4,
-              reach=b.get("reach", False),
-              crash_free=b.get("crash_free", False))
+    fn = ensemble._jitted_sharded(_mesh1(), b["W"], b["F"],
+                                  b["M"] + 4, b.get("reach", False),
+                                  b.get("crash_free", False))
     args = _wgl_sds(b)
-    traced = wgl._jitted_kernel().trace(*args, **kw)
+    traced = fn.trace(*args)
     jaxpr, staged, hlo, cost = _staged(traced, full)
-    f, ln = _provenance(wgl._kernel)
+    f, ln = _provenance(ensemble.check_batch_sharded
+                        if kernel_name == "wgl-sharded"
+                        else wgl._kernel)
     return KernelTrace(
         name=kernel_name, bucket=b["label"], jaxpr=jaxpr,
-        args=_argspecs(WGL_ARGS, args,
+        args=_argspecs(ensemble.SHARD_ARGS, args,
                        _donated_flags(staged, len(args))),
         hlo_text=hlo, cost=cost,
-        partition=None,
+        partition=spmd.describe_partition(spmd.WGL_RULES,
+                                          ensemble.SHARD_ARGS),
         batch_axes=[("row_seg", 0,
                      "independent search rows: one history / "
                      "(segment, start-state) pair per row")],
@@ -159,26 +174,34 @@ def _wgl_trace(b: dict, kernel_name: str,
 # ---------------------------------------------------------------------------
 
 def _sharded_trace(b: dict, full: bool = False) -> KernelTrace:
-    import numpy as np
+    return _wgl_trace(b, kernel_name="wgl-sharded", full=full)
 
-    import jax
 
-    from ..tpu import ensemble
+def _single_trace(b: dict, full: bool = False) -> KernelTrace:
+    """The plain single-device jit path (wgl._jitted_kernel) — what
+    wgl._launch runs on a 1-device process, under JEPSEN_TPU_SPMD=0
+    (the documented differential reference), and at the bottom of the
+    degradation ladder. The SPMD program owns the production batch
+    axis (R4 prices it on the entries above), so this trace declares
+    no batch axes; it exists to keep R1/R2/R3/R6 coverage of the
+    fallback's jaxpr — a donation or dtype regression in a
+    plain-path-only branch must not hide behind the sharded trace."""
+    from ..tpu import ensemble, wgl
 
-    mesh = jax.sharding.Mesh(np.array(jax.devices()[:1]), ("b",))
-    fn = ensemble._jitted_sharded(mesh, b["W"], b["F"], b["M"] + 4,
-                                  b.get("reach", False))
-    args = _wgl_sds(b)
-    traced = fn.trace(*args)
+    kw = dict(W=b["W"], F=b["F"], max_iters=b["M"] + 4,
+              reach=b.get("reach", False),
+              crash_free=b.get("crash_free", False))
+    args = _wgl_sds(b)[:7]  # no inv_perm: the plain kernel signature
+    traced = wgl._jitted_kernel().trace(*args, **kw)
     jaxpr, staged, hlo, cost = _staged(traced, full)
-    f, ln = _provenance(ensemble.check_batch_sharded)
+    f, ln = _provenance(wgl._kernel)
     return KernelTrace(
-        name="wgl-sharded", bucket=b["label"], jaxpr=jaxpr,
-        args=_argspecs(WGL_ARGS, args,
+        name="wgl-single", bucket=b["label"], jaxpr=jaxpr,
+        args=_argspecs(ensemble.SHARD_ARGS[:7], args,
                        _donated_flags(staged, len(args))),
         hlo_text=hlo, cost=cost,
-        partition=dict(SHARDED_PARTITION),
-        batch_axes=[("row_seg", 0, "independent search rows")],
+        partition=None,
+        batch_axes=[],
         bucket_policy="pow2", file=f, line=ln)
 
 
@@ -192,10 +215,21 @@ def _scc_trace(b: dict, full: bool = False,
     import jax
     import numpy as np
 
-    from ..tpu import scc
+    from ..tpu import scc, spmd
 
     n_pad, e_pad = b["n_pad"], b["e_pad"]
-    fn = scc._jitted_scc(n_pad, e_pad, scc.SWEEP_CAP, scc.ROUND_CAP)
+    single = kernel_name == "scc-single"
+    if single:
+        # the plain single-device compile — what scc_device runs on a
+        # 1-device process and under JEPSEN_TPU_SPMD=0 (same rationale
+        # as wgl-single: the fallback's donation/dtype/carry must not
+        # hide behind the sharded trace). No batch axes declared: the
+        # sharded entry owns the R4 story.
+        fn = scc._jitted_scc(n_pad, e_pad, scc.SWEEP_CAP,
+                             scc.ROUND_CAP)
+    else:
+        fn = scc._jitted_scc_sharded(_mesh1(), n_pad, e_pad,
+                                     scc.SWEEP_CAP, scc.ROUND_CAP)
     sds = jax.ShapeDtypeStruct
     args = (sds((n_pad,), np.bool_), sds((e_pad,), np.int32),
             sds((e_pad,), np.int32), sds((e_pad,), np.bool_))
@@ -204,13 +238,15 @@ def _scc_trace(b: dict, full: bool = False,
     f, ln = _provenance(scc.scc_device)
     return KernelTrace(
         name=kernel_name, bucket=b["label"], jaxpr=jaxpr,
-        args=_argspecs(SCC_ARGS, args,
+        args=_argspecs(scc.SCC_ARGS, args,
                        _donated_flags(staged, len(args))),
         hlo_text=hlo, cost=cost,
-        partition=None,
-        batch_axes=[("src", 0,
-                     "edge list: scatter-max sweeps are per-edge "
-                     "data-parallel")],
+        partition=None if single else
+        spmd.describe_partition(spmd.SCC_RULES, scc.SCC_ARGS),
+        batch_axes=[] if single else
+        [("src", 0,
+          "edge list: scatter-max sweeps are per-edge "
+          "data-parallel")],
         # edge buckets step linearly in 128Ki chunks above 2^17
         # (scc._edge_pad) — R5 prices that policy
         bucket_policy="linear", file=f, line=ln)
@@ -271,6 +307,9 @@ def entries() -> list[Entry]:
               "check_segmented per-segment reach rows"),
         Entry("wgl-sharded", _sharded_trace, SHARDED_BUCKETS,
               "check_batch_sharded mesh ensemble path"),
+        Entry("wgl-single", _single_trace, WGL_BUCKETS,
+              "single-device fallback jit (1-device processes, "
+              "JEPSEN_TPU_SPMD=0, ladder floor)"),
         Entry("wgl-slices",
               functools.partial(_wgl_trace,
                                 kernel_name="wgl-slices"),
@@ -278,6 +317,12 @@ def entries() -> list[Entry]:
               "check_slices fleet cross-tenant reach rows"),
         Entry("scc", _scc_trace, SCC_BUCKETS,
               "Orzan coloring SCC (elle_device cycle engine)"),
+        Entry("scc-single",
+              functools.partial(_scc_trace,
+                                kernel_name="scc-single"),
+              SCC_BUCKETS,
+              "single-device SCC compile (1-device processes, "
+              "JEPSEN_TPU_SPMD=0)"),
     ]
 
 

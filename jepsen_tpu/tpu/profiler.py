@@ -447,18 +447,14 @@ def check_efficiency(eff: dict[int, float],
     return bad
 
 
-def device_work(row_seg, seg_entries, n_devices: int) -> list[int]:
-    """Entries of search work per device for a sharded launch: rows are
-    laid out contiguously over the mesh's batch axis, so device d owns
-    rows [d*per, (d+1)*per). seg_entries maps segment index -> entry
-    count (padding rows index one past the end and count 0)."""
+def work_balance(work) -> float | None:
+    """Load-balance figure for a sharded launch's per-device work
+    attribution: mean/max — 1.0 is a perfectly even mesh, and with
+    zero replicated bytes (graftlint R4) an uneven balance is what's
+    left to explain a flat device sweep. None when no work landed."""
     import numpy as np
 
-    row_seg = np.asarray(row_seg)
-    ent = np.asarray(list(seg_entries) + [0])
-    per = max(len(row_seg) // max(n_devices, 1), 1)
-    work = []
-    for d in range(n_devices):
-        rows = row_seg[d * per:(d + 1) * per]
-        work.append(int(ent[np.clip(rows, 0, len(ent) - 1)].sum()))
-    return work
+    work = [int(w) for w in work]
+    if not work or max(work) == 0:
+        return None
+    return round(float(np.mean(work)) / max(work), 4)

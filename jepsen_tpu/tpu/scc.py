@@ -61,15 +61,18 @@ def _next_pow2(x: int) -> int:
     return n
 
 
-@lru_cache(maxsize=None)
-def _jitted_scc(n_pad: int, e_pad: int, sweep_cap: int,
-                round_cap: int):
-    """The ENTIRE Orzan peeling loop as one compiled launch per
-    (node, edge) shape bucket: one host->device upload of the edge
-    list, rounds and fixpoints run in nested lax.while_loops, one
-    download of (labels, ok). On a tunneled TPU the per-transfer
-    latency dominates sweep compute by orders of magnitude, so
-    round-trips — not FLOPs — are the budget."""
+def _scc_program(n_pad: int, sweep_cap: int, round_cap: int,
+                 combine=None):
+    """The ENTIRE Orzan peeling loop as one traceable program: rounds
+    and fixpoints run in nested lax.while_loops, one download of
+    (labels, ok). On a tunneled TPU the per-transfer latency dominates
+    sweep compute by orders of magnitude, so round-trips — not FLOPs
+    — are the budget.
+
+    `combine` is the SPMD hook: the sharded path passes a pmax over
+    the mesh axis, turning each sweep's scatter-max into local
+    scatter over the shard's edge block + one small all-reduce of the
+    color array — the edge list (the big operand) never replicates."""
     import jax
     import jax.numpy as jnp
 
@@ -85,7 +88,12 @@ def _jitted_scc(n_pad: int, e_pad: int, sweep_cap: int,
             vals = jnp.where(live_e, c[src], neutral)
             prop = jnp.full((n_pad,), neutral, dtype=jnp.int32
                             ).at[dst].max(vals)
+            if combine is not None:
+                prop = combine(prop)
             nc = jnp.maximum(c, prop)
+            # c and (combined) prop are replicated on the sharded
+            # path, so `changed` agrees across shards and the while
+            # loops stay in lockstep
             return nc, jnp.any(nc != c), it + 1
 
         c, changed, _ = jax.lax.while_loop(
@@ -134,7 +142,59 @@ def _jitted_scc(n_pad: int, e_pad: int, sweep_cap: int,
         # transfer pays full link latency on a tunneled TPU.
         return out.at[-1].set(done.astype(jnp.int32))
 
-    return jax.jit(full)
+    return full
+
+
+# The edge arrays (src/dst/edge_on — the big per-launch payload) are
+# donated: scc_device builds fresh device arrays per call, so XLA may
+# reuse them as scratch (graftlint R3). `active` stays live (tiny).
+DONATE_ARGNUMS = (1, 2, 3)
+SCC_ARGS = ("active", "src", "dst", "edge_on")
+
+
+@lru_cache(maxsize=None)
+def _jitted_scc(n_pad: int, e_pad: int, sweep_cap: int,
+                round_cap: int):
+    """Single-device compile of the peeling loop, one executable per
+    (node, edge) shape bucket."""
+    import jax
+
+    from . import spmd
+    from .wgl import quiet_unusable_donation
+
+    spmd.enable_compile_cache()
+    quiet_unusable_donation()
+    return jax.jit(_scc_program(n_pad, sweep_cap, round_cap),
+                   donate_argnums=DONATE_ARGNUMS)
+
+
+@lru_cache(maxsize=None)
+def _jitted_scc_sharded(mesh, n_pad: int, e_pad: int, sweep_cap: int,
+                        round_cap: int):
+    """SPMD compile: the edge list shards over the mesh's 'b' axis
+    (in key blocks — see scc_device), the color array stays
+    replicated, and each sweep's fixpoint combines per-shard
+    scatter-max results with ONE pmax of n_pad ints. Per-sweep
+    compute and H2D both scale ~1/N in edges; the collective moves
+    node-count bytes, not edge-count."""
+    import jax
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from . import spmd
+    from .wgl import quiet_unusable_donation
+
+    spmd.enable_compile_cache()
+    quiet_unusable_donation()
+    full = _scc_program(
+        n_pad, sweep_cap, round_cap,
+        combine=lambda prop: jax.lax.pmax(prop, spmd.AXIS))
+    specs = spmd.match_partition_rules(spmd.SCC_RULES, SCC_ARGS)
+    mapped = shard_map(full, mesh=mesh, in_specs=specs, out_specs=P(),
+                       check_rep=False)
+    shardings = tuple(NamedSharding(mesh, s) for s in specs)
+    return jax.jit(mapped, in_shardings=shardings,
+                   donate_argnums=DONATE_ARGNUMS)
 
 
 def _edge_pad(e: int) -> int:
@@ -147,19 +207,51 @@ def _edge_pad(e: int) -> int:
     return ((e + step - 1) // step) * step
 
 
-def scc_device(n: int, src, dst, emask=None) -> np.ndarray | None:
+def scc_device(n: int, src, dst, emask=None,
+               ekey=None) -> np.ndarray | None:
     """SCC labels per node (label = the component's max node id), or
     None when iteration caps were hit (caller must take the host
     path). Singleton components get their own id, so callers test
-    non-triviality by label multiplicity."""
+    non-triviality by label multiplicity.
+
+    On a multi-device process the edge list shards over the mesh
+    (_jitted_scc_sharded). ekey — the per-edge key id from the elle
+    edge-inference passes — orders the edge array into key blocks
+    first, so each device's contiguous shard covers whole keys:
+    same-key dependency edges (the bulk of ww/wr/rw) propagate inside
+    one shard and only cross-key session/realtime edges ride the
+    pmax. Labels are order-independent, so the layout cannot change
+    the verdict."""
     import jax.numpy as jnp
+
+    from . import spmd
 
     src = np.asarray(src, dtype=np.int32)
     dst = np.asarray(dst, dtype=np.int32)
     if n == 0:
         return np.empty(0, dtype=np.int32)
+    n_dev = spmd.spmd_devices()
+    if n_dev > 1:
+        # pow2 mesh sizes only, like ensemble.sharded_launch: each
+        # mesh size is its own compile family, and compile latency —
+        # not FLOPs — is this kernel's budget
+        n_dev = 1 << (n_dev.bit_length() - 1)
+    shard = n_dev > 1 and len(src) >= DEVICE_MIN_EDGES
+    if shard and ekey is not None and len(ekey) == len(src):
+        ekey = np.asarray(ekey)
+        if np.any(ekey[:-1] > ekey[1:]):
+            # callers that launch several graded subsets over one edge
+            # array (cycle_anomalies_arrays) pre-sort once; this sort
+            # only runs for one-shot callers
+            order = np.argsort(ekey, kind="stable")
+            src, dst = src[order], dst[order]
+            if emask is not None:
+                emask = np.asarray(emask)[order]
+        telemetry.count("scc.keyblock-layouts")
     n_pad = _next_pow2(n + 1)
     e_pad = _edge_pad(len(src))
+    if shard and e_pad % n_dev:
+        e_pad += n_dev - e_pad % n_dev
     # pad edges as self-loops on the sentinel (inactive) node n
     psrc = np.full(e_pad, n, dtype=np.int32)
     pdst = np.full(e_pad, n, dtype=np.int32)
@@ -167,16 +259,33 @@ def scc_device(n: int, src, dst, emask=None) -> np.ndarray | None:
     pdst[:len(dst)] = dst
     pmask = np.zeros(e_pad, dtype=bool)
     pmask[:len(src)] = True if emask is None else np.asarray(emask)
-    fn = _jitted_scc(n_pad, e_pad, SWEEP_CAP, ROUND_CAP)
     active = np.zeros(n_pad, dtype=bool)
     active[:n] = True
     prof = profiler.get()
-    bucket = ("scc", n_pad, e_pad)
-    rec = prof.begin("scc", bucket=bucket, nodes=n, edges=len(src))
+    if shard:
+        mesh = spmd.mesh_for(n_dev)
+        fn = _jitted_scc_sharded(mesh, n_pad, e_pad, SWEEP_CAP,
+                                 ROUND_CAP)
+        bucket = ("scc-sharded", n_dev, n_pad, e_pad)
+        telemetry.gauge_max("scc.spmd.devices", n_dev)
+    else:
+        fn = _jitted_scc(n_pad, e_pad, SWEEP_CAP, ROUND_CAP)
+        bucket = ("scc", n_pad, e_pad)
+    rec = prof.begin("scc", bucket=bucket, nodes=n, edges=len(src),
+                     devices=n_dev if shard else None)
     fresh = prof.bucket_fresh("scc", bucket)
     t0 = monotonic_ns()
-    args = (jnp.asarray(active), jnp.asarray(psrc),
-            jnp.asarray(pdst), jnp.asarray(pmask))
+    if shard:
+        import jax
+        from jax.sharding import NamedSharding
+
+        specs = spmd.match_partition_rules(spmd.SCC_RULES, SCC_ARGS)
+        args = tuple(
+            jax.device_put(a, NamedSharding(mesh, s))
+            for a, s in zip((active, psrc, pdst, pmask), specs))
+    else:
+        args = (jnp.asarray(active), jnp.asarray(psrc),
+                jnp.asarray(pdst), jnp.asarray(pmask))
     rec["h2d_ns"] = monotonic_ns() - t0
     try:
         t0 = monotonic_ns()
@@ -221,10 +330,13 @@ def _scc_host(n: int, src, dst) -> np.ndarray:
     return rep[comp]
 
 
-def scc(n: int, src, dst, emask=None, device: bool = True) -> np.ndarray:
+def scc(n: int, src, dst, emask=None, device: bool = True,
+        ekey=None) -> np.ndarray:
     """SCC labels (component max-id per node); device kernel with host
     fallback on non-convergence, host path outright for small graphs
-    (dispatch overhead dominates under DEVICE_MIN_EDGES edges)."""
+    (dispatch overhead dominates under DEVICE_MIN_EDGES edges).
+    ekey: optional per-edge key ids for the sharded path's key-block
+    layout (see scc_device)."""
     src = np.asarray(src)
     dst = np.asarray(dst)
     if emask is not None:
@@ -236,7 +348,7 @@ def scc(n: int, src, dst, emask=None, device: bool = True) -> np.ndarray:
     telemetry.count("scc.edges", n_live)
     if device and n_live >= DEVICE_MIN_EDGES:
         try:
-            labels = scc_device(n, src, dst, emask)
+            labels = scc_device(n, src, dst, emask, ekey=ekey)
         except Exception:
             labels = None
         if labels is not None:
@@ -268,8 +380,9 @@ def nontrivial_from_labels(labels: np.ndarray) -> list[np.ndarray]:
     return groups
 
 
-def nontrivial_sccs(n: int, src, dst, emask=None, device: bool = True
-                    ) -> list[np.ndarray]:
+def nontrivial_sccs(n: int, src, dst, emask=None, device: bool = True,
+                    ekey=None) -> list[np.ndarray]:
     if n == 0:
         return []
-    return nontrivial_from_labels(scc(n, src, dst, emask, device=device))
+    return nontrivial_from_labels(scc(n, src, dst, emask,
+                                      device=device, ekey=ekey))

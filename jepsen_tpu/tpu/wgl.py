@@ -33,6 +33,7 @@ from __future__ import annotations
 
 import functools
 import logging
+import os as _os
 import threading as _threading
 import time as _time
 import warnings as _warnings
@@ -504,6 +505,9 @@ def quiet_unusable_donation() -> None:
 def _jitted_kernel():
     import jax
 
+    from . import spmd
+
+    spmd.enable_compile_cache()
     quiet_unusable_donation()
     return jax.jit(_kernel, static_argnames=("W", "F", "max_iters",
                                              "reach", "debug",
@@ -808,6 +812,24 @@ def _timed_launch(bucket, dispatch, kernel: str = "wgl", lower=None,
 
 def _launch(pb: PackedBatch, rows: Sequence[tuple[int, int]], W: int,
             F: int, reach: bool):
+    """Dispatches one batched search. On a multi-device process the
+    rows (and their segment tensors, blocked per device — nothing
+    replicated) shard over the mesh via the SPMD program in
+    tpu/ensemble.py; single-device processes (and JEPSEN_TPU_SPMD=0)
+    take the plain jit path below. Every wgl entry point —
+    check_batch / check_batch_reach / check_segmented / check_slices,
+    and through the last one the fleet scheduler's cross-tenant
+    launches — funnels through here, so they all scale with the mesh
+    (and the chaos tests' monkeypatch seam stays this one function)."""
+    from . import spmd
+
+    rows = list(rows)
+    if spmd.spmd_devices() > 1 and len(rows) >= spmd.MIN_ROWS:
+        from . import ensemble
+
+        return ensemble.sharded_launch(
+            pb, rows, W, F, reach=reach,
+            kernel="wgl-reach" if reach else "wgl")
     import jax.numpy as jnp
 
     prof = profiler.get()
@@ -1158,12 +1180,127 @@ class _SegmentCheckpoint:
                 self.save_one(k, s, m)
 
 
+def _wave_bounds(K: int, early: bool) -> list[tuple[int, int]]:
+    """Segment-index waves for early-exit composition: geometric
+    doubling from 4, so a witness at fraction p of the history costs
+    O(p) launches + one wave of overshoot, while a valid history pays
+    only ~log2(K/4) extra dispatches over the single-launch path.
+    Without early exit (or for small K) everything is one wave."""
+    if not early or K < 8:
+        return [(0, K)]
+    out = []
+    lo, w = 0, 4
+    while lo < K:
+        out.append((lo, min(K, lo + w)))
+        lo += w
+        w *= 2
+    return out
+
+
+def _resolve_wave(enc: Encoded, segs, cuts, vcuts, lo: int, hi: int,
+                  S: int, W: int, F: int, prefix_screen: int,
+                  resolved: dict, pad_to: int | None = None) -> None:
+    """Resolves every unresolved (segment, start-state) reach mask for
+    segments [lo, hi): the device prefix screen first (rows whose
+    time-complete prefix proves mask 0 never reach the main launch),
+    then ONE batched reach launch over the survivors. Device failures
+    leave rows at None for the caller's lazy host floor. pad_to pads
+    the wave's packed batch with empty segments so wave launches
+    bucket to a fixed set of compile shapes."""
+    rows: list[tuple[int, int]] = []
+    if prefix_screen:
+        # Screening runs ON DEVICE: all (segment, start-state) prefix
+        # rows go up in one small batched reach launch (the prefixes
+        # bucket to one tiny kernel shape), replacing K x S sequential
+        # host searches. Rare UNKNOWN prefix rows fall back to the
+        # exact host search.
+        screen_rows: list[tuple[int, int]] = []
+        screen_segs: dict[int, tuple] = {}  # k -> (pre_enc, exact)
+        for k in range(lo, hi):
+            klo, khi = cuts[k], cuts[k + 1]
+            j = np.searchsorted(vcuts, klo + prefix_screen)
+            pre_end = int(vcuts[j]) if (j < len(vcuts)
+                                        and vcuts[j] < khi) else khi
+            if (pre_end - klo > 2 * prefix_screen
+                    or enc.crashed[klo:pre_end].any()):
+                # No NEARBY interior cut (one such "prefix" would pad
+                # the whole screen batch up to its length), or crashed
+                # entries in the prefix: screening can't shrink the
+                # work cheaply — leave every state to the main launch
+                # (minus checkpoint-restored entries).
+                rows.extend((k, s) for s in range(S)
+                            if resolved.get((k, s)) is None)
+                continue
+            exact = pre_end == khi
+            pre = segs[k] if exact else enc.segment(klo, pre_end)
+            screen_segs[k] = (pre, exact)
+            screen_rows.extend((k, s) for s in range(S)
+                               if resolved.get((k, s)) is None)
+        if screen_rows:
+            ks = sorted(screen_segs)
+            kidx = {k: i for i, k in enumerate(ks)}
+            launch_rows = [(kidx[k], s) for k, s in screen_rows]
+            try:
+                pre_pb = PackedBatch([screen_segs[k][0] for k in ks])
+                p_out, p_unk = _drain(
+                    _launch(pre_pb, launch_rows, W, F, reach=True),
+                    reach=True)
+                p_out = p_out[:len(launch_rows)]
+                p_unk = p_unk[:len(launch_rows)]
+            except Exception as e:  # noqa: BLE001 — ladder rung
+                # screen launch failed: every screened row resolves on
+                # host (the exact search — sound, just slower)
+                _ladder_classify(e, "segmented prefix screen")
+                _ladder_note("segment-host-screen")
+                p_out = np.zeros(len(launch_rows), dtype=np.uint32)
+                p_unk = np.ones(len(launch_rows), dtype=bool)
+            for i, (k, s) in enumerate(screen_rows):
+                pre, exact = screen_segs[k]
+                mask = (search_host_reach(pre.with_init(s))
+                        if p_unk[i] else int(p_out[i]))
+                if exact:
+                    resolved[(k, s)] = mask
+                elif mask == 0:
+                    resolved[(k, s)] = 0
+                else:
+                    rows.append((k, s))
+    else:
+        rows = [(k, s) for k in range(lo, hi) for s in range(S)
+                if resolved.get((k, s)) is None]
+    if not rows:
+        return
+    # One packed copy per segment; rows share it via the kernel's
+    # row->segment indirection. Device failure marks every row
+    # unresolved: the composition host-searches ONLY the states it
+    # actually reaches (the lazy floor), and each result still
+    # checkpoints, so a retry resumes instead of re-searching.
+    wave_segs = list(segs[lo:hi])
+    if pad_to and len(wave_segs) < pad_to:
+        empty = enc.segment(cuts[lo], cuts[lo])
+        wave_segs += [empty] * (pad_to - len(wave_segs))
+    try:
+        pb = PackedBatch(wave_segs)
+        launch_rows = [(k - lo, s) for k, s in rows]
+        out, unk = _drain(_launch(pb, launch_rows, W, F, reach=True),
+                          reach=True)
+        out = out[:len(launch_rows)]
+        unk = unk[:len(launch_rows)]
+        for i, (k, s) in enumerate(rows):
+            resolved[(k, s)] = None if unk[i] else int(out[i])
+    except Exception as e:  # noqa: BLE001 — ladder rung
+        _ladder_classify(e, "segmented main launch")
+        _ladder_note("segment-host-floor")
+        for k, s in rows:
+            resolved.setdefault((k, s), None)
+
+
 def check_segmented(enc: Encoded, target_len: int | None = None,
                     W: int = 24,
                     F: int = 48, witness: bool = False,
                     prefix_screen: int = 96,
                     checkpoint_path=None,
-                    checkpoint_dir=None) -> dict | None:
+                    checkpoint_dir=None,
+                    early_exit: bool | None = None) -> dict | None:
     """Checks one long history by cutting it into segments, computing
     per-(segment, start-state) final-state reachability in ONE batched
     device launch, and composing reachability masks across segments.
@@ -1191,7 +1328,16 @@ def check_segmented(enc: Encoded, target_len: int | None = None,
     later write). The screen itself is one batched device reach
     launch over all prefix rows (a tiny kernel bucket); rare UNKNOWN
     rows fall back to the exact host search. Wrong start states die
-    in the prefix, so the main launch runs ~half the rows."""
+    in the prefix, so the main launch runs ~half the rows.
+
+    early_exit (default on; JEPSEN_TPU_EARLY_EXIT=0 disables):
+    segments resolve in geometric waves composed as they land, so an
+    invalid history witnessed at fraction p of the search costs ~p of
+    the check (PR 9's `search.witness-position` proves where the
+    anomaly localizes; doc/spmd.md documents the semantics). Verdicts,
+    masks, witnesses and certificates are identical either way — a
+    wave resolves exactly the masks the single launch would have, and
+    composition stops at the same failed segment."""
     if enc.n_states > 32:
         # the per-(segment, state) reach masks are uint32 bitmasks; a
         # bigger state space silently fell back to the whole-history
@@ -1238,128 +1384,70 @@ def check_segmented(enc: Encoded, target_len: int | None = None,
             enc, cuts)
     if ckpt is not None:
         resolved.update(ckpt.load())
-    rows: list[tuple[int, int]] = []
-    if prefix_screen:
-        # Screening itself runs ON DEVICE: all (segment, start-state)
-        # prefix rows go up in one small batched reach launch (the
-        # prefixes bucket to one tiny kernel shape), replacing
-        # K x S sequential host searches that used to dominate the
-        # segmented check's host time. Rare UNKNOWN prefix rows fall
-        # back to the exact host search.
-        screen_rows: list[tuple[int, int]] = []
-        screen_segs: dict[int, tuple] = {}  # k -> (pre_enc, exact)
-        for k in range(K):
-            lo, hi = cuts[k], cuts[k + 1]
-            j = np.searchsorted(vcuts, lo + prefix_screen)
-            pre_end = int(vcuts[j]) if (j < len(vcuts)
-                                        and vcuts[j] < hi) else hi
-            if (pre_end - lo > 2 * prefix_screen
-                    or enc.crashed[lo:pre_end].any()):
-                # No NEARBY interior cut (the first valid cut sits
-                # deep in the segment — one such "prefix" would pad
-                # the whole screen batch up to its length and cost as
-                # much as the main launch), or crashed entries in the
-                # prefix: screening can't shrink the work cheaply —
-                # leave every state to the main kernel launch (minus
-                # checkpoint-restored entries).
-                rows.extend((k, s) for s in range(S)
-                            if resolved.get((k, s)) is None)
-                continue
-            exact = pre_end == hi
-            pre = segs[k] if exact else enc.segment(lo, pre_end)
-            screen_segs[k] = (pre, exact)
-            screen_rows.extend((k, s) for s in range(S)
-                               if resolved.get((k, s)) is None)
-        if screen_rows:
-            ks = sorted(screen_segs)
-            kidx = {k: i for i, k in enumerate(ks)}
-            launch_rows = [(kidx[k], s) for k, s in screen_rows]
-            try:
-                pre_pb = PackedBatch([screen_segs[k][0] for k in ks])
-                p_out, p_unk = _drain(
-                    _launch(pre_pb, launch_rows, W, F, reach=True),
-                    reach=True)
-                p_out = p_out[:len(launch_rows)]
-                p_unk = p_unk[:len(launch_rows)]
-            except Exception as e:  # noqa: BLE001 — ladder rung
-                # screen launch failed: every screened row resolves on
-                # host (the exact search — sound, just slower)
-                _ladder_classify(e, "segmented prefix screen")
-                _ladder_note("segment-host-screen")
-                p_out = np.zeros(len(launch_rows), dtype=np.uint32)
-                p_unk = np.ones(len(launch_rows), dtype=bool)
-            for i, (k, s) in enumerate(screen_rows):
-                pre, exact = screen_segs[k]
-                mask = (search_host_reach(pre.with_init(s))
-                        if p_unk[i] else int(p_out[i]))
-                if exact:
-                    resolved[(k, s)] = mask
-                elif mask == 0:
-                    resolved[(k, s)] = 0
-                else:
-                    rows.append((k, s))
-    else:
-        rows = [(k, s) for k in range(K) for s in range(S)
-                if resolved.get((k, s)) is None]
-    if rows:
-        # One packed copy per segment; rows share it via the kernel's
-        # row->segment indirection. Device failure marks every row
-        # unresolved: the composition below host-searches ONLY the
-        # states it actually reaches (the lazy floor), and each result
-        # still checkpoints, so a retry resumes instead of re-searching.
-        try:
-            pb = PackedBatch(segs)
-            out, unk = _drain(_launch(pb, rows, W, F, reach=True),
-                              reach=True)
-            out = out[:len(rows)]
-            unk = unk[:len(rows)]
-            for i, (k, s) in enumerate(rows):
-                resolved[(k, s)] = None if unk[i] else int(out[i])
-        except Exception as e:  # noqa: BLE001 — ladder rung
-            _ladder_classify(e, "segmented main launch")
-            _ladder_note("segment-host-floor")
-            for k, s in rows:
-                resolved.setdefault((k, s), None)
-    if ckpt is not None:
-        ckpt.save(resolved)
+    early = early_exit if early_exit is not None else \
+        _os.environ.get("JEPSEN_TPU_EARLY_EXIT", "1") != "0"
+    waves = _wave_bounds(K, early)
     reach = 1 << enc.init_state
     reaches = [reach]  # reachable-state mask entering each segment
-    for k in range(K):
-        nreach = 0
-        for s in range(S):
-            if (reach >> s) & 1:
-                mask = resolved[(k, s)]
-                if mask is None:
-                    mask = search_host_reach(segs[k].with_init(s))
-                    resolved[(k, s)] = mask
-                    if ckpt is not None:
-                        ckpt.save_one(k, s, mask)
-                nreach |= mask
-        if nreach == 0:
-            res: dict = {"valid?": False, "failed-segment": k,
-                         "segment-range": [cuts[k], cuts[k + 1]]}
-            wstate = next(s for s in range(S) if (reach >> s) & 1)
-            chain = _reach_chain(resolved, reaches, k, wstate)
-            if chain is not None:
-                # the reach/choice data a certificate re-derives the
-                # pre-witness linearization from (jepsen_tpu.tpu
-                # .certify); also where the witness sits in the
-                # history — the early-exit signal (ROADMAP item 3)
-                res["search-chain"] = {"cuts": [int(c) for c in cuts],
-                                       "chain": chain}
-            if witness:
-                w = search_host(segs[k].with_init(wstate),
-                                witness=True)
-                res.update({kk: v for kk, v in w.items()
-                            if kk != "valid?"})
-                if "witness-entry" in res:
-                    # globalize the segment-local stuck entry
-                    res["witness-entry"] = int(
-                        cuts[k] + res["witness-entry"])
-                    res["entry-count"] = int(enc.m)
-            return res
-        reach = nreach
-        reaches.append(reach)
+    wstate = 0
+    failed_k = None
+    for lo, hi in waves:
+        _resolve_wave(enc, segs, cuts, vcuts, lo, hi, S, W, F,
+                      prefix_screen, resolved,
+                      pad_to=(_next_pow2(hi - lo)
+                              if len(waves) > 1 else None))
+        if ckpt is not None:
+            ckpt.save(resolved)
+        for k in range(lo, hi):
+            nreach = 0
+            for s in range(S):
+                if (reach >> s) & 1:
+                    mask = resolved.get((k, s))
+                    if mask is None:
+                        mask = search_host_reach(segs[k].with_init(s))
+                        resolved[(k, s)] = mask
+                        if ckpt is not None:
+                            ckpt.save_one(k, s, mask)
+                    nreach |= mask
+            if nreach == 0:
+                failed_k = k
+                wstate = next(s for s in range(S) if (reach >> s) & 1)
+                break
+            reach = nreach
+            reaches.append(reach)
+        if failed_k is not None:
+            if hi < K:
+                # the early-exit payoff: segments past the witness's
+                # wave were never launched — an anomaly at 12% of the
+                # history cost ~12% of the search
+                telemetry.count("wgl.segmented.early-exit")
+                telemetry.gauge(
+                    "wgl.segmented.early-exit-frac",
+                    round(cuts[hi] / max(enc.m, 1), 4))
+            break
+    if failed_k is not None:
+        k = failed_k
+        res: dict = {"valid?": False, "failed-segment": k,
+                     "segment-range": [cuts[k], cuts[k + 1]]}
+        chain = _reach_chain(resolved, reaches, k, wstate)
+        if chain is not None:
+            # the reach/choice data a certificate re-derives the
+            # pre-witness linearization from (jepsen_tpu.tpu
+            # .certify); also where the witness sits in the
+            # history — the early-exit signal (ROADMAP item 3)
+            res["search-chain"] = {"cuts": [int(c) for c in cuts],
+                                   "chain": chain}
+        if witness:
+            w = search_host(segs[k].with_init(wstate),
+                            witness=True)
+            res.update({kk: v for kk, v in w.items()
+                        if kk != "valid?"})
+            if "witness-entry" in res:
+                # globalize the segment-local stuck entry
+                res["witness-entry"] = int(
+                    cuts[k] + res["witness-entry"])
+                res["entry-count"] = int(enc.m)
+        return res
     final_state = next(s for s in range(S) if (reach >> s) & 1)
     chain = _reach_chain(resolved, reaches, K, final_state)
     res = {"valid?": True, "segments": K}

@@ -237,10 +237,13 @@ class TestRecorderGuards:
 
     def test_scc_failure_unclaims_bucket(self, fresh, monkeypatch):
         """Site-level: an scc device launch that dies keeps the bucket
-        fresh for the retry (the wgl._timed_launch discard analog)."""
+        fresh for the retry (the wgl._timed_launch discard analog).
+        Single-device path pinned via JEPSEN_TPU_SPMD=0 (the sharded
+        factory is a separate bucket family)."""
         def boom(*a, **k):
             raise RuntimeError("RESOURCE_EXHAUSTED: boom")
 
+        monkeypatch.setenv("JEPSEN_TPU_SPMD", "0")
         monkeypatch.setattr(scc, "_jitted_scc", lambda *a, **k: boom)
         rng = np.random.default_rng(0)
         n, e = 2000, 25_000
@@ -516,11 +519,14 @@ class TestScalingAttribution:
         assert profiler.check_efficiency({1: 1.0, 8: 0.9},
                                          log=msgs.append) == []
 
-    def test_device_work(self):
-        work = profiler.device_work(
-            row_seg=[0, 0, 1, 2, 3, 3, 3, 3],  # 3 = sentinel/padding
-            seg_entries=[10, 20, 30], n_devices=4)
-        assert work == [20, 50, 0, 0]
+    def test_work_balance(self):
+        # the sharded launches' load-balance figure (the contiguous
+        # device_work helper died with the blocked shard layout —
+        # ensemble.shard_layout attributes work per device now)
+        assert profiler.work_balance([40, 40, 40, 40]) == 1.0
+        assert profiler.work_balance([80, 40]) == 0.75
+        assert profiler.work_balance([]) is None
+        assert profiler.work_balance([0, 0]) is None
 
 
 class TestTelemetryFilters:
