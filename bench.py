@@ -839,8 +839,12 @@ def bench_fleet_throughput(n_runs=8, ops_each=3000):
     sequentially, one launch each — the baseline a tenant pool without
     a fleet pays. Verdict parity is asserted per run. vs_baseline =
     fleet aggregate ops/s over solo aggregate ops/s (>1 = the shared
-    pool beats N separate checkers); device utilization rides along as
-    mean histories per final launch (solo is by construction 1.0)."""
+    pool beats N separate checkers); device utilization rides along
+    as mean histories per FINAL launch (slice launches reported
+    separately — the old blended average over-stated utilization)
+    plus the flight recorder's per-class packed-rows/capacity
+    occupancy. The last round's stats feed the fleet-latency line and
+    the ledger's fleet block (_fleet_latency_line)."""
     import shutil
     import statistics as _st
     import tempfile
@@ -892,7 +896,7 @@ def bench_fleet_throughput(n_runs=8, ops_each=3000):
         for t in threads:
             t.join()
         wall = time.time() - t0
-        st = srv.stats()["scheduler"]
+        st = srv.stats()
         srv.stop()
         shutil.rmtree(base, ignore_errors=True)  # WALs per round add up
         return wall, out, st
@@ -906,11 +910,21 @@ def bench_fleet_throughput(n_runs=8, ops_each=3000):
     mism = sum(1 for i, r in enumerate(solo_res)
                if out[i]["result"]["valid?"] != r["valid?"])
     assert mism == 0, f"{mism} fleet verdicts diverged from solo"
-    launches = max(st["launches"], 1)
-    util = st["final_hists"] / launches
+    _FLEET_ROUND.clear()
+    _FLEET_ROUND.update(st)
+    sch = st["scheduler"]
+    finals = max(sch.get("final_launches", 0), 1)
+    util = sch["final_hists"] / finals
+    fr = st.get("flightrec") or {}
+    occ = {c: (v or {}).get("occupancy", 0.0)
+           for c, v in (fr.get("classes") or {}).items()}
     _log(f"fleet-throughput: {n_runs} tenants fleet {fleet_s:.2f}s "
-         f"vs solo {solo_s:.2f}s, {util:.1f} hists/launch "
-         f"(cross-tenant launches: {st['cross_tenant_launches']})")
+         f"vs solo {solo_s:.2f}s, {util:.1f} hists/final-launch over "
+         f"{sch.get('final_launches', 0)} final + "
+         f"{sch.get('slice_launches', 0)} slice launches, occupancy "
+         f"slice {occ.get('slice', 0.0):.0%} "
+         f"final {occ.get('final', 0.0):.0%} "
+         f"(cross-tenant launches: {sch['cross_tenant_launches']})")
     return {
         "metric": f"fleet-throughput ({n_runs} concurrent tenants vs "
                   f"{n_runs} solo checks, verdict parity asserted)",
@@ -919,6 +933,132 @@ def bench_fleet_throughput(n_runs=8, ops_each=3000):
         "vs_baseline": round((total_ops / fleet_s)
                              / (total_ops / solo_s), 3),
         "hists_per_launch": round(util, 2),
+        "slice_launches": sch.get("slice_launches", 0),
+        "final_launches": sch.get("final_launches", 0),
+        "occupancy": {c: round(v, 3) for c, v in occ.items()},
+    }
+
+
+# the newest measured fleet round's stats() (scheduler + flightrec):
+# bench_fleet_throughput fills it; the fleet-latency line and the
+# ledger's fleet block read it
+_FLEET_ROUND: dict = {}
+
+
+def _fleet_latency_line():
+    """The fleet-latency BENCH line: verdict/ack latency quantiles,
+    launch-weighted mean occupancy, and the scheduler decision log
+    from the throughput rounds' flight recorder. An observation line
+    (vs_baseline 1.0), not a race."""
+    fr = _FLEET_ROUND.get("flightrec") or {}
+    v = fr.get("verdict_ms") or {}
+    if not fr.get("enabled") or not v.get("n"):
+        return []
+    classes = fr.get("classes") or {}
+    launches = sum((c or {}).get("launches", 0)
+                   for c in classes.values())
+    mean_occ = sum((c or {}).get("occupancy", 0.0)
+                   * (c or {}).get("launches", 0)
+                   for c in classes.values()) / max(launches, 1)
+    ack = fr.get("ack_ms") or {}
+    dec = fr.get("decisions") or {}
+    _log(f"fleet-latency: verdict p50 {v.get('p50')}ms "
+         f"p99 {v.get('p99')}ms ack p99 {ack.get('p99')}ms over "
+         f"{v.get('n')} verdicts, mean occupancy {mean_occ:.0%}, "
+         "decisions " + " ".join(f"{r}={dec.get(r, 0)}"
+                                 for r in sorted(dec)))
+    return [{
+        "metric": f"fleet-latency verdict p99 "
+                  f"({v.get('n')} verdicts, flight recorder)",
+        "value": v.get("p99"),
+        "unit": "ms",
+        "vs_baseline": 1.0,
+        "p50": v.get("p50"),
+        "ack_p99": ack.get("p99"),
+        "occupancy": {c: round((d or {}).get("occupancy", 0.0), 3)
+                      for c, d in classes.items()},
+        "mean_occupancy": round(mean_occ, 3),
+        "decisions": dict(dec),
+    }]
+
+
+def bench_flightrec_overhead(n_runs=4, ops_each=600):
+    """Flight-recorder overhead (ISSUE 17): the identical multi-tenant
+    fleet round with the recorder instrumented vs disabled
+    (FleetServer(flightrec=False)). Verdict parity is asserted between
+    the two modes; vs_baseline = disabled/instrumented wall, and a
+    ratio beyond the 2% budget gets a loud banner."""
+    import shutil
+    import statistics as _st
+    import tempfile
+    import threading as _th
+
+    from jepsen_tpu.fleet import client as fclient
+    from jepsen_tpu.fleet import scheduler as fsched
+    from jepsen_tpu.fleet import server as fserver
+    from jepsen_tpu.tpu import synth
+
+    hists = [synth.register_history(ops_each, seed=4200 + i)
+             for i in range(n_runs)]
+
+    def one_round(flightrec):
+        base = tempfile.mkdtemp(prefix="flightrec-bench-")
+        sched = fsched.Scheduler(window_s=0.05)
+        srv = fserver.FleetServer(
+            base, scheduler=sched,
+            quotas=fserver.Quotas(max_tenants=n_runs + 1,
+                                  max_total_streams=2 * n_runs),
+            stream_checks=False, flightrec=flightrec).start()
+        out = {}
+
+        def tenant(i):
+            c = fclient.FleetClient(srv.addr, f"ovh{i}", "r",
+                                    model="cas-register")
+            ops = list(hists[i])
+            for j in range(0, len(ops), 128):
+                c.send_chunk(ops[j:j + 128])
+            out[i] = c.finish(timeout_s=120)
+            c.close()
+
+        t0 = time.time()
+        threads = [_th.Thread(target=tenant, args=(i,))
+                   for i in range(n_runs)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.time() - t0
+        srv.stop()
+        shutil.rmtree(base, ignore_errors=True)
+        return wall, out
+
+    one_round(True)  # warm
+    on_walls, off_walls = [], []
+    on_out = off_out = None
+    for _ in range(3):
+        w, on_out = one_round(True)
+        on_walls.append(w)
+        w, off_out = one_round(False)
+        off_walls.append(w)
+    on_s, off_s = _st.median(on_walls), _st.median(off_walls)
+    mism = sum(1 for i in range(n_runs)
+               if on_out[i]["result"] != off_out[i]["result"])
+    assert mism == 0, \
+        f"{mism} verdicts changed with the recorder on"
+    ratio = on_s / max(off_s, 1e-9)
+    if ratio > 1.02:
+        _log(f"!!! flightrec-overhead: {ratio:.3f}x exceeds the 2% "
+             "budget")
+    _log(f"flightrec-overhead: instrumented {on_s:.2f}s disabled "
+         f"{off_s:.2f}s ({ratio:.3f}x), verdict parity "
+         f"{n_runs}/{n_runs}")
+    return {
+        "metric": f"flightrec-overhead (instrumented vs disabled "
+                  f"fleet round, {n_runs} tenants, verdict parity "
+                  "asserted)",
+        "value": round(ratio, 4),
+        "unit": "x",
+        "vs_baseline": round(off_s / max(on_s, 1e-9), 3),
     }
 
 
@@ -1026,6 +1166,8 @@ _KERNEL_METRICS = (
     ("ensemble linearizability", "wgl-ensemble", True),
     ("time-to-first-anomaly", "anomaly", False),
     ("fleet-throughput", "fleet", True),
+    ("fleet-latency", "fleet-latency", False),
+    ("flightrec-overhead", "flightrec-overhead", False),
 )
 
 
@@ -1083,6 +1225,20 @@ def _ledger_entry(lines, headline):
     }
     if search:
         out["search"] = search
+    # the fleet flight recorder's SLO/utilization round summary
+    # (ISSUE 17): verdict/ack quantiles + per-class occupancy +
+    # decision log, tracked per round like the kernels
+    fr = (_FLEET_ROUND.get("flightrec") or {})
+    if fr.get("enabled") and (fr.get("verdict_ms") or {}).get("n"):
+        out["fleet"] = {
+            "verdict_p50_ms": (fr.get("verdict_ms") or {}).get("p50"),
+            "verdict_p99_ms": (fr.get("verdict_ms") or {}).get("p99"),
+            "ack_p99_ms": (fr.get("ack_ms") or {}).get("p99"),
+            "occupancy": {
+                c: (d or {}).get("occupancy")
+                for c, d in (fr.get("classes") or {}).items()},
+            "decisions": dict(fr.get("decisions") or {}),
+        }
     if _LINT_AGGREGATES:
         # the R3/R4 aggregates the SPMD rebuild (ROADMAP items 1-2)
         # must drive to zero, tracked per round like the kernels
@@ -1233,6 +1389,8 @@ def main():
                          (bench_analyze_resume, ()),
                          (bench_fleet_throughput,
                           ((8, 600) if small else (8, 3000))),
+                         (bench_flightrec_overhead,
+                          ((4, 300) if small else (4, 600))),
                          (bench_list_append,
                           (10_000 if small else 100_000,)),
                          (bench_rw_register,
@@ -1244,6 +1402,10 @@ def main():
                 lines.append(fn(*args))
             except Exception as e:  # extras must never sink the headline
                 _log(f"{fn.__name__} failed: {e!r}")
+        try:
+            lines.extend(_fleet_latency_line())
+        except Exception as e:  # noqa: BLE001 — observation line only
+            _log(f"fleet-latency line failed: {e!r}")
     headline = bench_headline(n_events)
     lines.extend(_telemetry_lines())
     try:

@@ -36,6 +36,7 @@ CONCURRENCY_MODULE_NAMES = (
     "jepsen_tpu.fleet.scheduler",
     "jepsen_tpu.fleet.server",
     "jepsen_tpu.fleet.client",
+    "jepsen_tpu.fleet.flightrec",
     "jepsen_tpu.chaos",
 )
 

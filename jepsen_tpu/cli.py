@@ -637,6 +637,60 @@ def lint_cmd() -> dict:
     return {"lint": {"parser_fn": build, "run": run}}
 
 
+def _fleet_top_lines(stats: dict) -> list[str]:
+    """Renders `fleet top`'s frame from a stats() reply: the flight
+    recorder's SLO quantiles, per-tenant latency tracks, per-class
+    occupancy, and the scheduler decision log. Pure text-from-dict so
+    tests exercise it without a terminal."""
+    fr = stats.get("flightrec") or {}
+    lines = []
+    sched = stats.get("scheduler") or {}
+    lines.append(
+        f"streams {stats.get('streams', 0)}  "
+        f"chunks {stats.get('chunks', 0)}  "
+        f"verdicts {stats.get('verdicts', 0)}  "
+        f"launches {sched.get('launches', 0)}")
+    if not fr.get("enabled"):
+        lines.append("flight recorder disabled")
+        return lines
+
+    def q(d, key):
+        v = (d or {}).get(key)
+        return "     -" if v is None else f"{v:10.2f}"
+
+    v, a = fr.get("verdict_ms") or {}, fr.get("ack_ms") or {}
+    lines.append(f"verdict ms  p50 {q(v, 'p50')}  p95 {q(v, 'p95')}"
+                 f"  p99 {q(v, 'p99')}   (n={v.get('n', 0)})")
+    lines.append(f"ack ms      p50 {q(a, 'p50')}  p95 {q(a, 'p95')}"
+                 f"  p99 {q(a, 'p99')}   (n={a.get('n', 0)})")
+    tenants = fr.get("tenants") or {}
+    if tenants:
+        lines.append(f"{'tenant':<16} {'verdict p50':>12} "
+                     f"{'verdict p99':>12} {'ack p99':>10} "
+                     f"{'items':>7}")
+        fair = fr.get("fairness") or {}
+        for t in sorted(tenants):
+            td = tenants[t]
+            lines.append(
+                f"{t:<16} {q(td.get('verdict_ms'), 'p50'):>12} "
+                f"{q(td.get('verdict_ms'), 'p99'):>12} "
+                f"{q(td.get('ack_ms'), 'p99'):>10} "
+                f"{(fair.get(t) or {}).get('items', 0):>7}")
+    for cls, c in sorted((fr.get("classes") or {}).items()):
+        lines.append(
+            f"{cls:<7} launches {c.get('launches', 0):>5}  "
+            f"rows/launch {c.get('rows_per_launch', 0.0):>8.2f}  "
+            f"occupancy {c.get('occupancy', 0.0):>6.1%}")
+    dec = fr.get("decisions") or {}
+    lines.append("decisions  " + "  ".join(
+        f"{r}={dec.get(r, 0)}" for r in
+        ("full", "timeout", "drain", "breaker")))
+    idle = fr.get("idle") or {}
+    lines.append(f"device idle  {idle.get('gaps', 0)} gaps, "
+                 f"{idle.get('total_ms', 0.0):.1f} ms total")
+    return lines
+
+
 def fleet_cmd() -> dict:
     """A 'fleet' subcommand: the checking-as-a-service data plane
     (jepsen_tpu.fleet; doc/fleet.md).
@@ -645,13 +699,18 @@ def fleet_cmd() -> dict:
       fleet submit <run>     stream a stored run's history.jlog to the
                              fleet and print its verdict
       fleet status           the server's per-tenant stats
+      fleet top              live SLO/utilization view (flight rec.)
+      fleet explain <run>    a verdict's latency decomposition
+      fleet trace            write the Perfetto fleet-session view
     """
     def build(p):
         p.add_argument("action", choices=["serve", "submit",
-                                          "status"])
+                                          "status", "top", "explain",
+                                          "trace"])
         p.add_argument("run_dir", nargs="?", default=None,
                        help="submit: a stored run dir (or a "
-                            "history.jlog) to stream.")
+                            "history.jlog) to stream. explain: the "
+                            "run name whose verdict to decompose.")
         p.add_argument("--base", default="store/fleet",
                        help="Fleet state dir (WALs, verdicts, "
                             "fleet.addr).")
@@ -673,6 +732,13 @@ def fleet_cmd() -> dict:
         p.add_argument("--chunk-ops", type=int, default=256)
         p.add_argument("--max-tenants", type=int, default=8)
         p.add_argument("--max-streams", type=int, default=16)
+        p.add_argument("--interval", type=float, default=2.0,
+                       help="top: seconds between refreshes.")
+        p.add_argument("--iterations", type=int, default=0,
+                       help="top: stop after N frames (0 = forever).")
+        p.add_argument("--out", default=None,
+                       help="trace: output path (default "
+                            "<base>/fleet-trace.json).")
         return p
 
     def _addr(options):
@@ -716,6 +782,69 @@ def fleet_cmd() -> dict:
                                     "status", observe=True)
             print(_json.dumps(c.status(), indent=2, sort_keys=True))
             c.close()
+            return 0
+        if options.action == "top":
+            import time as _time
+            i = 0
+            while True:
+                c = fclient.FleetClient(_addr(options),
+                                        options.tenant, "status",
+                                        observe=True)
+                try:
+                    stats = c.status()
+                finally:
+                    c.close()
+                print("\n".join(_fleet_top_lines(stats)))
+                i += 1
+                if options.iterations and i >= options.iterations:
+                    return 0
+                print()
+                _time.sleep(options.interval)
+        if options.action == "explain":
+            if not options.run_dir:
+                raise CliError("fleet explain needs a run name")
+            from .fleet import flightrec as frec
+
+            c = fclient.FleetClient(_addr(options), options.tenant,
+                                    options.run_dir)
+            try:
+                env = c.claim()
+            finally:
+                c.close()
+            lat = env.get("latency") if isinstance(env, dict) \
+                else None
+            if not isinstance(lat, dict):
+                print("no latency block (flight recorder disabled?)")
+                return 2
+            frec.validate_latency(lat)
+            for k in frec.LATENCY_KEYS:
+                print(f"  {k:>15}  {lat.get(k, 0.0):9.3f} ms")
+            print(f"  {'total':>15}  "
+                  f"{lat.get('total_ms', 0.0):9.3f} ms")
+            if lat.get("replay"):
+                print("  (replayed after restart: ingest/WAL slices "
+                      "predate the crash and read zero)")
+            k, v = frec.dominant_slice(lat)
+            print(f"dominant slice: {k} ({v:.3f} ms)")
+            return 0
+        if options.action == "trace":
+            from pathlib import Path
+
+            from .fleet import flightrec as frec
+            from .reports import trace as rtrace
+
+            snap = Path(options.base) / frec.SNAPSHOT_FILE
+            try:
+                d = _json.loads(snap.read_text())
+            except (OSError, ValueError):
+                raise CliError(
+                    f"no flight-recorder snapshot at {snap}")
+            doc = rtrace.fleet_chrome_trace(d.get("records") or [])
+            out = Path(options.out) if options.out \
+                else Path(options.base) / "fleet-trace.json"
+            with open(out, "w") as f:
+                _json.dump(doc, f)
+            print(f"wrote {out} ({len(doc['traceEvents'])} events)")
             return 0
         # submit: stream a stored history
         if not options.run_dir:

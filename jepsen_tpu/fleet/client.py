@@ -28,8 +28,10 @@ import socket
 import threading
 import time
 
-from .. import telemetry
+from .. import telemetry, tracing
 from ..control.retry import RetryBudget, decorrelated_jitter
+from ..monitor import LogHistogram
+from . import flightrec as frec
 from . import wire
 
 logger = logging.getLogger(__name__)
@@ -100,6 +102,11 @@ class FleetClient:
         self._pending_failed = False  # last send_chunk raised
         self._claim_only = False      # claim(): resume is expected
         self.last_verdict: dict | None = None
+        # client-observed ack latency (send -> journaled ack), the
+        # tenant's half of the fleet SLO story; rides into
+        # results['fleet'] via FleetStreamer.result_summary
+        self.ack_ms = LogHistogram()
+        self.last_latency: dict | None = None  # server's block
 
     # -- connection ------------------------------------------------------
 
@@ -146,6 +153,9 @@ class FleetClient:
         # stream, and silently treating its journal as our acks would
         # return a verdict computed on someone else's data.
         srv_seq = int(reply.get("last_seq", 0))
+        if isinstance(reply.get("verdict"), dict) \
+                and isinstance(reply.get("latency"), dict):
+            self.last_latency = reply["latency"]
         if not self.observe and not self._claim_only \
                 and srv_seq > len(self._chunks):
             self._disconnect()
@@ -217,18 +227,35 @@ class FleetClient:
         self.budget.refund()  # the fleet answered: it is alive
         return seq
 
+    def _tc(self) -> dict:
+        """The flight-recorder trace context minted per frame: the
+        send stamp on the cross-process monotonic clock, plus the
+        caller's optrace span ids when it is inside one — the link
+        that joins server-side flight-recorder spans back to the
+        run's own trace (wire.py documents the field)."""
+        tc = {"t": frec.now()}
+        ctx = tracing.current_context()
+        if ctx:
+            tc.update(ctx)
+        return tc
+
     def _drive_to(self, seq: int) -> None:
         """Sends chunks (self._acked, seq] and consumes acks until the
         server's journal covers seq, rewinding on resync acks."""
         while self._acked < seq:
             nxt = self._acked + 1
+            tc = self._tc()
             self.transport.send(self._sock, {
                 "type": "chunk", "seq": nxt,
-                "ops": self._chunks[nxt - 1]})
+                "ops": self._chunks[nxt - 1], "tc": tc})
             reply = self.transport.recv(self._sock)
             t = reply.get("type")
             if t == "ack":
                 acked = int(reply.get("seq", 0))
+                if acked >= nxt:
+                    # a real advance (not a resync rewind): the
+                    # durability promise's round trip, client-side
+                    self.ack_ms.add((frec.now() - tc["t"]) / 1e6)
                 # a resync ack rewinds; a normal ack advances. Either
                 # way the server's number is the truth.
                 self._acked = min(max(acked, 0), len(self._chunks))
@@ -247,18 +274,21 @@ class FleetClient:
         def once():
             self._drive_to(len(self._chunks))
             self.transport.send(self._sock, {
-                "type": "fin", "chunks": len(self._chunks)})
+                "type": "fin", "chunks": len(self._chunks),
+                "tc": self._tc()})
             reply = self.transport.recv(self._sock)
             if reply.get("type") == "ack" and reply.get("resync"):
                 raise wire.FrameError("fin resync")  # rewind + retry
             if reply.get("type") != "verdict":
                 raise wire.FrameError(
                     f"unexpected fin reply {reply!r}")
+            if isinstance(reply.get("latency"), dict):
+                self.last_latency = reply["latency"]
             return reply["result"]
 
         while True:
             try:
-                v = self._with_retry(once)
+                v = self._with_latency(self._with_retry(once))
                 self.last_verdict = v
                 self.budget.refund()
                 return v
@@ -288,14 +318,27 @@ class FleetClient:
             if reply.get("type") != "verdict":
                 raise wire.FrameError(
                     f"unexpected claim reply {reply!r}")
+            if isinstance(reply.get("latency"), dict):
+                self.last_latency = reply["latency"]
             return reply["result"]
 
         self._claim_only = True
         try:
-            v = self._with_retry(once)
+            v = self._with_latency(self._with_retry(once))
         finally:
             self._claim_only = False
         self.last_verdict = v
+        return v
+
+    def _with_latency(self, v):
+        """Attaches the verdict's latency block (flightrec critical-
+        path decomposition, ridden next to the verdict on the wire)
+        onto a COPY of the returned env — the server's verdict dict
+        itself stays exactly the verdict file's content."""
+        if isinstance(v, dict) and "latency" not in v \
+                and isinstance(self.last_latency, dict):
+            v = dict(v)
+            v["latency"] = self.last_latency
         return v
 
     def status(self) -> dict:
@@ -417,8 +460,14 @@ class FleetStreamer:
             return {"unavailable": self.fallen_back}
         try:
             v = self.client.finish(timeout_s=timeout_s)
-            return {"verdict": v, "addr": list(self.client.addr),
-                    "tenant": self.client.tenant}
+            out = {"verdict": v, "addr": list(self.client.addr),
+                   "tenant": self.client.tenant}
+            h = self.client.ack_ms
+            if h.n:  # the client's own view of the durability SLO
+                out["ack_ms"] = {"n": h.n,
+                                 "p50": round(h.quantile(0.5), 3),
+                                 "p99": round(h.quantile(0.99), 3)}
+            return out
         except Exception as e:  # noqa: BLE001 — honest absence
             return {"unavailable": str(e)[:200]}
         finally:

@@ -37,8 +37,9 @@ import time
 from collections import deque
 from typing import Any
 
-from .. import telemetry
+from .. import telemetry, util
 from ..history import History
+from . import flightrec as frec
 
 logger = logging.getLogger(__name__)
 
@@ -51,9 +52,14 @@ BREAKER_COOLDOWN_S = 5.0
 
 class WorkItem:
     """One unit of checker work. `done` is set exactly once, after
-    `result` (never both unset across an exception path)."""
+    `result` (never both unset across an exception path). `times` is
+    the flight recorder's stamp sheet (submit/drain/launch0/launch1
+    in frec.now() ns, plus the launch's per-item encode/device/
+    certify ms shares) — written only by the submitting thread and
+    the batch loop, read after `done`."""
 
-    __slots__ = ("kind", "tenant", "run", "payload", "result", "done")
+    __slots__ = ("kind", "tenant", "run", "payload", "result", "done",
+                 "times")
 
     def __init__(self, kind: str, tenant: str, run: str, payload):
         self.kind = kind          # 'final' | 'slice'
@@ -62,6 +68,7 @@ class WorkItem:
         self.payload = payload
         self.result: Any = None
         self.done = threading.Event()
+        self.times: dict = {"submit": frec.now()}
 
     def finish(self, result) -> None:
         self.result = result
@@ -122,20 +129,27 @@ class Scheduler:
 
     def __init__(self, max_batch: int = MAX_BATCH,
                  window_s: float = WINDOW_S,
-                 quantum: float = QUANTUM):
+                 quantum: float = QUANTUM,
+                 flightrec: "frec.FlightRecorder | None" = None):
         self.max_batch = max_batch
         self.window_s = window_s
         self.quantum = quantum
+        # the flight recorder is attached once, before start() (the
+        # server shares its own) — a lifecycle attr, not shared state
+        self.flightrec = flightrec
         self._lock = threading.Lock()
         self._work = threading.Condition(self._lock)
         self._queues: dict[str, _TenantQueue] = {}
         self._order: deque[str] = deque()  # round-robin ring
         self._pending = 0
-        self._stats = {"launches": 0, "items": 0, "slice_rows": 0,
-                       "final_hists": 0, "cross_tenant_launches": 0,
+        self._stats = {"launches": 0, "slice_launches": 0,
+                       "final_launches": 0, "items": 0,
+                       "slice_rows": 0, "final_hists": 0,
+                       "cross_tenant_launches": 0,
                        "max_tenants_in_launch": 0, "host_floor": 0}
         self._breaker = _DeviceBreaker()
         self._stop = threading.Event()
+        self._drain_req = threading.Event()
         self._thread: threading.Thread | None = None
 
     # -- submission ------------------------------------------------------
@@ -179,6 +193,7 @@ class Scheduler:
 
     def start(self) -> "Scheduler":
         self._stop.clear()
+        self._drain_req.clear()
         self._thread = threading.Thread(target=self._loop,
                                         name="fleet-scheduler",
                                         daemon=True)
@@ -186,6 +201,11 @@ class Scheduler:
         return self
 
     def stop(self) -> None:
+        # graceful stop FLUSHES: the loop drains what is already
+        # queued into final launches (decision-log reason "drain")
+        # before exiting. kill() sets _stop alone — a SIGKILL
+        # abandons in-flight work, it doesn't flush it.
+        self._drain_req.set()
         self._stop.set()
         with self._lock:
             self._work.notify_all()
@@ -250,13 +270,34 @@ class Scheduler:
                         continue
                 # a short accumulation window so concurrent tenants'
                 # submissions land in ONE launch (continuous batching)
-            time.sleep(self.window_s)
+            # the window wait is interruptible: a graceful stop
+            # mid-window falls through to the drain flush below
+            # instead of sleeping the window out while stop() times
+            # out and resolves the queued work "unknown"
+            if self._stop.wait(timeout=self.window_s):
+                break
             with self._lock:
                 batch = self._drain_fair_locked()
             if batch:
-                self._run_batch(batch)
+                # the decision log's why: a full batch fired on size,
+                # a partial one on window expiry
+                reason = "full" if len(batch) >= self.max_batch \
+                    else "timeout"
+                self._run_batch(batch, reason)
+        # graceful stop (stop(), never kill()): flush what is already
+        # queued so accepted work gets real verdicts, not "unknown"
+        while self._drain_req.is_set():
+            with self._lock:
+                batch = self._drain_fair_locked()
+            if not batch:
+                break
+            self._run_batch(batch, "drain")
 
-    def _run_batch(self, batch: list[WorkItem]) -> None:
+    def _run_batch(self, batch: list[WorkItem],
+                   reason: str = "timeout") -> None:
+        t_drain = frec.now()
+        for i in batch:
+            i.times["drain"] = t_drain
         tenants = {i.tenant for i in batch}
         with self._lock:
             st = self._stats
@@ -272,22 +313,47 @@ class Scheduler:
         finals = [i for i in batch if i.kind == "final"]
         try:
             if slices:
-                self._run_slices(slices)
+                self._run_slices(slices, reason)
         finally:
             # finals still run if the slice pass died; and every item
             # resolves no matter what (finish() below is uncond.)
             if finals:
-                self._run_finals(finals)
+                self._run_finals(finals, reason)
 
-    def _run_slices(self, items: list[WorkItem]) -> None:
+    def _stamp_launch(self, items: list[WorkItem], t0: int, t1: int,
+                      device_ms: float, certify_ms: float) -> None:
+        """Per-item stamp of the shared launch: the group's measured
+        phase totals split evenly across its items (a batched launch
+        has no truer per-item attribution than its fair share)."""
+        n = max(len(items), 1)
+        wall_ms = (t1 - t0) / 1e6
+        encode_ms = max(wall_ms - device_ms - certify_ms, 0.0)
+        for i in items:
+            i.times.update(
+                launch0=t0, launch1=t1,
+                encode_ms=encode_ms / n, device_ms=device_ms / n,
+                certify_ms=certify_ms / n)
+
+    def _run_slices(self, items: list[WorkItem],
+                    reason: str) -> None:
         from ..tpu import wgl
 
         pairs = [i.payload for i in items]  # (Encoded, start_state)
         try:
+            t0, r0 = frec.now(), util.relative_time_nanos()
             out, unk = wgl.check_slices(pairs)
+            t1, r1 = frec.now(), util.relative_time_nanos()
+            device_ms, certify_ms = frec.kernel_phases(r0, r1)
+            self._stamp_launch(items, t0, t1, device_ms, certify_ms)
             with self._lock:
                 self._stats["launches"] += 1
+                self._stats["slice_launches"] += 1
                 self._stats["slice_rows"] += len(pairs)
+            if self.flightrec is not None:
+                self.flightrec.launch(
+                    "slice", reason, t0, t1, rows=len(pairs),
+                    capacity=self.max_batch, items=items,
+                    device_ms=device_ms, certify_ms=certify_ms)
             self._breaker.record(True)
             for i, mask, u in zip(items, out, unk):
                 i.finish({"mask": int(mask), "unknown": bool(u)})
@@ -299,7 +365,8 @@ class Scheduler:
                     i.finish({"mask": 0, "unknown": True,
                               "error": repr(e)})
 
-    def _run_finals(self, items: list[WorkItem]) -> None:
+    def _run_finals(self, items: list[WorkItem],
+                    reason: str) -> None:
         """Finals grouped per model spec -> one batched launch per
         group. payload: {'engine': 'wgl'|'elle', 'model': name,
         'history': History}."""
@@ -310,25 +377,41 @@ class Scheduler:
             key = (i.payload["model"], i.payload.get("initial"))
             groups.setdefault(key, []).append(i)
         for (model_name, initial), group in groups.items():
-            self._run_final_group(model_name, initial, group)
+            self._run_final_group(model_name, initial, group, reason)
 
     def _run_final_group(self, model_name: str, initial,
-                         group: list[WorkItem]) -> None:
+                         group: list[WorkItem],
+                         reason: str) -> None:
         from . import build_model, elle_checks
 
         engine = group[0].payload["engine"]
         hists = [g.payload["history"] for g in group]
+        # the breaker decision is made HERE, once per group, so the
+        # decision log can attribute the launch to it
+        host = engine == "wgl" and not self._breaker.allow_device()
+        if host:
+            reason = "breaker"
         try:
+            t0, r0 = frec.now(), util.relative_time_nanos()
             if engine == "wgl":
                 results = self._wgl_finals(
-                    build_model(model_name, initial), hists)
+                    build_model(model_name, initial), hists, host)
             else:
                 check = elle_checks()[model_name]
                 results = [check(h, {"certify": True})
                            for h in hists]
+            t1, r1 = frec.now(), util.relative_time_nanos()
+            device_ms, certify_ms = frec.kernel_phases(r0, r1)
+            self._stamp_launch(group, t0, t1, device_ms, certify_ms)
             with self._lock:
                 self._stats["launches"] += 1
+                self._stats["final_launches"] += 1
                 self._stats["final_hists"] += len(hists)
+            if self.flightrec is not None:
+                self.flightrec.launch(
+                    "final", reason, t0, t1, rows=len(hists),
+                    capacity=self.max_batch, items=group,
+                    device_ms=device_ms, certify_ms=certify_ms)
             self._breaker.record(True)
             for g, r in zip(group, results):
                 g.finish(r)
@@ -340,10 +423,11 @@ class Scheduler:
                 if not g.done.is_set():
                     g.finish({"valid?": "unknown", "error": repr(e)})
 
-    def _wgl_finals(self, model, hists) -> list[dict]:
+    def _wgl_finals(self, model, hists,
+                    host: bool = False) -> list[dict]:
         from ..tpu import wgl
 
-        if not self._breaker.allow_device():
+        if host:
             # breaker open: the pure-host reference search — the fleet
             # degrades to slower, never to wrong or wedged
             with self._lock:

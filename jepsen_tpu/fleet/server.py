@@ -42,6 +42,7 @@ from pathlib import Path
 from .. import telemetry
 from ..history import History
 from . import elle_checks, wgl_models, wire
+from . import flightrec as frec
 from . import scheduler as fsched
 from . import wal as fwal
 
@@ -80,7 +81,8 @@ class RunState:
     must stay atomic."""
 
     _guarded_by_lock = {"lock": ("last_seq", "n_ops", "fin",
-                                 "verdict", "wal")}
+                                 "verdict", "wal", "t_first", "t_fin",
+                                 "wal_ns", "latency")}
 
     def __init__(self, tenant: str, run: str, model: str,
                  wal: fwal.RunWAL | None, stream=None, initial=None):
@@ -97,6 +99,14 @@ class RunState:
         self.touched = time.monotonic()  # last hello/ingest
         self.verdict: dict | None = None
         self.verdict_ready = threading.Event()
+        # flight-recorder stamps (frec.now() ns / accumulated append
+        # ns). These feed the verdict's latency block — which rides
+        # the wire NEXT to the verdict, never inside the verdict file
+        # (the byte-identical replay contract).
+        self.t_first: int | None = None  # first journaled chunk
+        self.t_fin: int | None = None    # fin journaled
+        self.wal_ns = 0                  # summed WAL-append wall
+        self.latency: dict | None = None
 
     def retire_wal(self) -> None:
         """Closes the WAL fd once the run can never append again (fin
@@ -122,7 +132,8 @@ def prometheus_from_stats(st: dict) -> str:
               "recovered", "frame_errors", "runs", "active_streams"):
         g(k, st.get(k, 0))
     sch = st.get("scheduler") or {}
-    for k in ("launches", "items", "slice_rows", "final_hists",
+    for k in ("launches", "slice_launches", "final_launches",
+              "items", "slice_rows", "final_hists",
               "cross_tenant_launches", "pending"):
         g(f"scheduler_{k}", sch.get(k, 0))
     for tenant, ts in sorted((st.get("tenants") or {}).items()):
@@ -130,6 +141,35 @@ def prometheus_from_stats(st: dict) -> str:
         for k in ("streams", "chunks", "ops", "verdicts",
                   "rejected"):
             g(f"tenant_{k}", ts.get(k, 0), lab)
+    # flight-recorder series (jepsen_tpu.fleet.flightrec): SLO
+    # quantiles fleet-wide and per tenant, per-class occupancy, the
+    # scheduler decision log, device idle. Every sample here must
+    # pass flightrec.validate_prometheus (tests gate it).
+    fr = st.get("flightrec") or {}
+    if fr.get("enabled"):
+        def quants(name, qd, extra=""):
+            for q in ("p50", "p95", "p99"):
+                v = (qd or {}).get(q)
+                if isinstance(v, (int, float)):
+                    g(name, v, '{%sq="%s"}' % (extra, q))
+
+        quants("verdict_latency_ms", fr.get("verdict_ms"))
+        quants("ack_latency_ms", fr.get("ack_ms"))
+        for tenant, td in sorted((fr.get("tenants") or {}).items()):
+            quants("tenant_verdict_latency_ms",
+                   td.get("verdict_ms"), f'tenant="{tenant}",')
+            quants("tenant_ack_latency_ms",
+                   td.get("ack_ms"), f'tenant="{tenant}",')
+        for cls, cd in sorted((fr.get("classes") or {}).items()):
+            lab = '{cls="%s"}' % cls
+            g("class_launches", cd.get("launches", 0), lab)
+            g("class_rows", cd.get("rows", 0), lab)
+            g("class_occupancy", cd.get("occupancy", 0.0), lab)
+        for reason, n in sorted((fr.get("decisions") or {}).items()):
+            g("decisions_total", n, '{reason="%s"}' % reason)
+        idle = fr.get("idle") or {}
+        g("device_idle_ms_total", idle.get("total_ms", 0.0))
+        g("device_idle_gaps", idle.get("gaps", 0))
     return "\n".join(lines) + "\n"
 
 
@@ -140,7 +180,8 @@ class FleetServer:
     def __init__(self, base, host: str = "127.0.0.1", port: int = 0,
                  quotas: Quotas | None = None,
                  scheduler: fsched.Scheduler | None = None,
-                 stream_checks: bool = True):
+                 stream_checks: bool = True,
+                 flightrec: bool = True):
         self.base = Path(base)
         self.host = host
         self.port = port
@@ -148,6 +189,12 @@ class FleetServer:
         self.scheduler = scheduler if scheduler is not None \
             else fsched.Scheduler()
         self.stream_checks = stream_checks
+        # the flight recorder is shared with the scheduler (its
+        # launch/decision records land in the same session); disabled
+        # it costs nothing (bench.py prices the delta)
+        self.flightrec = frec.FlightRecorder(enabled=bool(flightrec))
+        if self.scheduler.flightrec is None and flightrec:
+            self.scheduler.flightrec = self.flightrec
         self._lock = threading.Lock()
         self._runs: dict[tuple[str, str], RunState] = {}
         self._active: dict[tuple[str, str], int] = {}  # open streams
@@ -170,6 +217,9 @@ class FleetServer:
 
     def start(self) -> "FleetServer":
         self.base.mkdir(parents=True, exist_ok=True)
+        # fold the previous incarnation's SLO histograms BEFORE
+        # recovery, so replayed verdicts land on restored history
+        self.flightrec.load(self.base / frec.SNAPSHOT_FILE)
         self.recover()
         self.scheduler.start()
         s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -270,12 +320,18 @@ class FleetServer:
             rs.fin = folded["fin"] is not None
             if verdict is not None:
                 rs.verdict = verdict
+                # a recovered-from-file verdict still carries a
+                # complete latency block — replay-annotated, every
+                # slice honestly zero (its timings died with the
+                # crashed process)
+                if self.flightrec.enabled:
+                    rs.latency = frec.replay_block()
                 rs.verdict_ready.set()
             with self._lock:
                 self._runs[(tenant, run)] = rs
             if rs.fin and verdict is None:
                 ops = fwal.replay_ops(folded)
-                self._submit_final(rs, ops)
+                self._submit_final(rs, ops, replay=True)
                 n += 1
                 with self._lock:
                     self._stats["recovered"] += 1
@@ -309,6 +365,7 @@ class FleetServer:
                      if s is not None}
         out["streams"] = streaming
         out["scheduler"] = self.scheduler.stats()
+        out["flightrec"] = self.flightrec.snapshot()
         return out
 
     def prometheus_text(self) -> str:
@@ -470,6 +527,8 @@ class FleetServer:
         reply = {"type": "helloed", "last_seq": rs.last_seq}
         if rs.verdict is not None:
             reply["verdict"] = rs.verdict
+            if rs.latency is not None:
+                reply["latency"] = rs.latency
         wire.send_msg(conn, reply)
         return rs, key
 
@@ -498,6 +557,7 @@ class FleetServer:
         return None
 
     def _chunk(self, conn, rs: RunState, msg) -> None:
+        t_recv = frec.now()
         seq = msg.get("seq")
         ops = msg.get("ops")
         if not isinstance(seq, int) or seq < 1 \
@@ -535,7 +595,12 @@ class FleetServer:
                                      "reason": "stream finished"})
                 return
             # WAL BEFORE ack: the ack promises durability
+            w0 = frec.now()
             rs.wal.append({"t": "chunk", "seq": seq, "ops": ops})
+            wal_ns = frec.now() - w0
+            rs.wal_ns += wal_ns
+            if rs.t_first is None:
+                rs.t_first = t_recv
             rs.last_seq = seq
             rs.n_ops += len(ops)
             rs.touched = time.monotonic()
@@ -557,6 +622,14 @@ class FleetServer:
             ts["ops"] += len(ops)
         telemetry.count("fleet.chunks")
         wire.send_msg(conn, {"type": "ack", "seq": seq})
+        # the span closes at the ack — the durability promise's wall
+        # clock. Only JOURNALED chunks reach here: dup re-acks and
+        # resync acks above return early, so chaos duplication can
+        # never double-count a span.
+        tc = msg.get("tc") if isinstance(msg.get("tc"), dict) else {}
+        self.flightrec.chunk(
+            rs.tenant, rs.run, seq, t_recv, frec.now(), wal_ns,
+            len(ops), client_t=tc.get("t"), trace=tc.get("trace"))
 
     def _fin(self, conn, rs: RunState, msg) -> None:
         with rs.lock:
@@ -570,7 +643,10 @@ class FleetServer:
                 return
             first_fin = not rs.fin and rs.wal is not None
             if first_fin:
+                w0 = frec.now()
                 rs.wal.append({"t": "fin", "chunks": rs.last_seq})
+                rs.wal_ns += frec.now() - w0
+                rs.t_fin = frec.now()
                 rs.fin = True
         if first_fin:
             folded = fwal.replay(fwal.wal_path(self.base, rs.tenant,
@@ -578,46 +654,101 @@ class FleetServer:
             self._submit_final(rs, fwal.replay_ops(folded))
         self._claim(conn, rs)
 
-    def _submit_final(self, rs: RunState, ops: list) -> None:
+    def _submit_final(self, rs: RunState, ops: list,
+                      replay: bool = False) -> None:
         engine = "wgl" if rs.model in wgl_models() else "elle"
         item = self.scheduler.submit(
             "final", rs.tenant, rs.run,
             {"engine": engine, "model": rs.model,
              "initial": rs.initial, "history": History(ops)})
-        threading.Thread(target=self._await_verdict, args=(rs, item),
+        threading.Thread(target=self._await_verdict,
+                         args=(rs, item, replay),
                          name=f"fleet-verdict-{rs.tenant}-{rs.run}",
                          daemon=True).start()
 
-    def _await_verdict(self, rs: RunState, item) -> None:
+    def _latency_block(self, rs: RunState, item, serialize_ms: float,
+                       replay: bool) -> dict:
+        """The per-verdict critical-path decomposition from the run's
+        ingest stamps and the item's scheduler stamp sheet. Replayed
+        runs (recover()) lost their ingest timings with the crash —
+        their slices are zero and the block says so."""
+        tm = item.times
+        ingest = _wal = 0.0
+        if not replay:
+            with rs.lock:
+                if rs.t_first is not None and rs.t_fin is not None:
+                    ingest = (rs.t_fin - rs.t_first) / 1e6
+                _wal = rs.wal_ns / 1e6
+        queue = batching = 0.0
+        if "drain" in tm:
+            queue = (tm["drain"] - tm["submit"]) / 1e6
+        if "launch0" in tm and "drain" in tm:
+            batching = (tm["launch0"] - tm["drain"]) / 1e6
+        return frec.latency_block(
+            ingest_wait_ms=ingest, wal_fsync_ms=_wal,
+            queue_wait_ms=queue, batching_delay_ms=batching,
+            encode_ms=tm.get("encode_ms", 0.0),
+            device_ms=tm.get("device_ms", 0.0),
+            certify_ms=tm.get("certify_ms", 0.0),
+            serialize_ms=serialize_ms, replay=replay)
+
+    def _await_verdict(self, rs: RunState, item,
+                       replay: bool = False) -> None:
         item.done.wait(timeout=VERDICT_TIMEOUT_S)
         result = item.result if item.done.is_set() else \
             {"valid?": "unknown", "error": "fleet verdict timeout"}
         # NOTE: nothing timing-dependent goes in here — the verdict
-        # file must replay byte-identical after a crash (the streaming
-        # status is live telemetry; it rides in stats(), not here)
+        # file must replay byte-identical after a crash (the latency
+        # block below rides NEXT to the verdict on the wire and in
+        # stats, never inside these bytes; streaming status likewise)
         verdict = {"tenant": rs.tenant, "run": rs.run,
                    "model": rs.model, "n_ops": rs.n_ops,
                    "result": fwal.json_safe(result)}
+        s0 = frec.now()
         try:
             fwal.write_verdict(self.base, rs.tenant, rs.run, verdict)
         except OSError:
             logger.exception("writing verdict file failed")
+        serialize_ms = (frec.now() - s0) / 1e6
+        # a disabled recorder means NO latency accounting anywhere —
+        # the wire envelope matches a pre-flightrec server's exactly
+        latency = self._latency_block(rs, item, serialize_ms, replay) \
+            if self.flightrec.enabled else None
         with rs.lock:
             rs.verdict = verdict
-        rs.verdict_ready.set()
-        rs.retire_wal()  # the run can never append again
+            rs.latency = latency
+        # all accounting lands BEFORE verdict_ready fires: a client
+        # whose finish() returns must already see the verdict in
+        # stats()/prometheus and in the recorder's SLO histograms
         with self._lock:
             self._stats["verdicts"] += 1
             self._tstat_locked(rs.tenant)["verdicts"] += 1
         telemetry.count("fleet.verdicts")
+        # SLO clock: fin -> verdict ready (a replayed run's fin died
+        # with the crash; its re-submit time is the honest start)
+        t0 = rs.t_fin if rs.t_fin is not None \
+            else item.times["submit"]
+        self.flightrec.verdict(rs.tenant, rs.run, t0, frec.now(),
+                               latency)
+        # the snapshot also lands before verdict_ready: a client that
+        # kills the server the instant finish() returns still finds
+        # this verdict's SLO history on disk for the successor to fold
+        try:
+            self.flightrec.save(self.base / frec.SNAPSHOT_FILE)
+        except OSError:  # pragma: no cover — accounting is advisory
+            logger.exception("flightrec snapshot failed")
+        rs.verdict_ready.set()
+        rs.retire_wal()  # the run can never append again
 
     def _claim(self, conn, rs: RunState) -> None:
         deadline = time.monotonic() + VERDICT_TIMEOUT_S
         while time.monotonic() < deadline \
                 and not self._stopping.is_set():
             if rs.verdict_ready.wait(timeout=1.0):
-                wire.send_msg(conn, {"type": "verdict",
-                                     "result": rs.verdict})
+                reply = {"type": "verdict", "result": rs.verdict}
+                if rs.latency is not None:
+                    reply["latency"] = rs.latency
+                wire.send_msg(conn, reply)
                 return
         wire.send_msg(conn, {"type": "error",
                              "reason": "verdict not ready"})

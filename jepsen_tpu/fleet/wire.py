@@ -12,18 +12,27 @@ Messages are JSON dicts with a "type" key:
 
   client -> server
     hello   {tenant, run, model, weight}   open/resume a stream
-    chunk   {seq, ops}                     one batch of history ops
-    fin     {chunks}                       stream complete; check it
+    chunk   {seq, ops, tc?}                one batch of history ops
+    fin     {chunks, tc?}                  stream complete; check it
     claim   {}                             wait for the run's verdict
     status  {}                             server + per-tenant stats
 
   server -> client
-    helloed {last_seq, verdict?}           admitted (resume point)
+    helloed {last_seq, verdict?, latency?} admitted (resume point)
     reject  {reason, retry_after}          admission control said no
     ack     {seq}                          chunk journaled (WAL'd)
-    verdict {result}                       the run's verdict + cert
+    verdict {result, latency?}             the run's verdict + cert
     stats   {...}                          status reply
     error   {reason}                       protocol violation
+
+`tc` is the flight recorder's trace context (jepsen_tpu.fleet.
+flightrec), minted by the client per (tenant, run, seq): {"t":
+monotonic-ns send stamp, "trace"?: the run's optrace trace id}. It is
+OPTIONAL and backward-compatible both ways — an old server ignores
+it, an old client simply never sends it — so every chunk's lifecycle
+links into one cross-process span without a protocol version bump.
+`latency` rides NEXT to the verdict for the same reason: the verdict
+file's bytes must stay timing-free (byte-identical crash replay).
 """
 
 from __future__ import annotations

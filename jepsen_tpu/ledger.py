@@ -177,6 +177,35 @@ def validate_entries(entries) -> int:
                 elif not isinstance(v, int) or isinstance(v, bool):
                     raise ValueError(
                         f"entry {i}: bad lint field {k!r}: {v!r}")
+        # optional fleet flight-recorder summary (jepsen_tpu.fleet.
+        # flightrec): verdict/ack latency quantiles, per-class batch
+        # occupancy, and the scheduler decision-log counts
+        fl = e.get("fleet")
+        if fl is not None:
+            if not isinstance(fl, dict):
+                raise ValueError(f"entry {i}: bad fleet stats {fl!r}")
+            for k, v in fl.items():
+                if k == "occupancy":
+                    if not isinstance(v, dict) or not all(
+                            x is None or (
+                                isinstance(x, (int, float))
+                                and not isinstance(x, bool)
+                                and 0 <= x <= 1)
+                            for x in v.values()):
+                        raise ValueError(
+                            f"entry {i}: bad fleet occupancy {v!r}")
+                elif k == "decisions":
+                    if not isinstance(v, dict) or not all(
+                            isinstance(x, int)
+                            and not isinstance(x, bool)
+                            for x in v.values()):
+                        raise ValueError(
+                            f"entry {i}: bad fleet decisions {v!r}")
+                elif v is not None and (
+                        isinstance(v, bool)
+                        or not isinstance(v, (int, float))):
+                    raise ValueError(
+                        f"entry {i}: bad fleet field {k!r}: {v!r}")
         n += 1
     return n
 

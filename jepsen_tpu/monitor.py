@@ -113,6 +113,39 @@ class LogHistogram:
         return {"n": self.n, "zeros": self.zeros,
                 "counts": {str(b): c for b, c in self.counts.items()}}
 
+    @classmethod
+    def from_dict(cls, d: dict) -> "LogHistogram":
+        """Inverse of to_dict, tolerant of junk (a torn snapshot
+        folds as empty rather than poisoning the observer). With
+        merge() this is the cross-process path: the fleet flight
+        recorder persists histograms per verdict and a restarted
+        server — or an external observer — folds them back in."""
+        out = cls()
+        if not isinstance(d, dict):
+            return out
+        try:
+            counts = {int(b): int(c)
+                      for b, c in (d.get("counts") or {}).items()
+                      if int(c) > 0}
+            zeros = max(int(d.get("zeros") or 0), 0)
+        except (AttributeError, TypeError, ValueError):
+            return out
+        out.counts = counts
+        out.zeros = zeros
+        # n is derived, not trusted: merge associativity needs the
+        # invariant n == zeros + sum(counts) to survive round trips
+        out.n = zeros + sum(counts.values())
+        return out
+
+    @classmethod
+    def merge_dicts(cls, dicts) -> "LogHistogram":
+        """Folds many serialized histograms (merge is associative and
+        commutative, so observer-side folding order is irrelevant)."""
+        out = cls()
+        for d in dicts:
+            out = out.merge(cls.from_dict(d))
+        return out
+
 
 # ---------------------------------------------------------------------------
 # Nemesis activity tracking

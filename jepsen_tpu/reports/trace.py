@@ -59,6 +59,10 @@ _PID_CLIENTS = 2
 _PID_NEMESIS = 3
 _PID_DEVICE = 4
 _PID_NODE_BASE = 10  # node i gets pid _PID_NODE_BASE + i
+# the fleet flight recorder's session view (fleet_chrome_trace) —
+# far from the node range so a combined viewer never collides
+_PID_FLEET_TENANTS = 90
+_PID_FLEET_SVC = 91
 
 
 def _us(ns: int) -> float:
@@ -415,6 +419,96 @@ def write_trace(run_dir, out_path=None, ops=None) -> Path:
     with open(out, "w") as f:
         json.dump(doc, f)
     return out
+
+
+def fleet_chrome_trace(records) -> dict:
+    """The fleet flight recorder's session view: renders
+    fleet/flightrec records (FlightRecorder.records()) as a Chrome
+    trace — one track per tenant (chunk ack spans + verdict spans,
+    args carrying the latency decomposition), a device-launch track
+    with a batch-occupancy counter, and WAL + scheduler swimlanes.
+    Timestamps rebase to the earliest record so the raw monotonic
+    clock starts at zero. The document passes
+    validate_chrome_trace."""
+    recs = [r for r in records or [] if isinstance(r, dict)
+            and isinstance(r.get("t0"), int)
+            and isinstance(r.get("t1"), int)]
+    events: list[dict] = []
+    _process_meta(events, _PID_FLEET_TENANTS, "fleet tenants")
+    _process_meta(events, _PID_FLEET_SVC, "fleet service")
+    ten = _Tids(events, _PID_FLEET_TENANTS, sort_index=0)
+    svc = _Tids(events, _PID_FLEET_SVC, sort_index=1)
+    t_base = min((r["t0"] for r in recs), default=0)
+
+    def ts(ns: int) -> float:
+        return _us(ns - t_base)
+
+    for r in recs:
+        kind = r.get("kind")
+        dur = max(_us(r["t1"] - r["t0"]), 0.001)
+        if kind == "chunk":
+            args = {k: r[k] for k in ("wal_ms", "ack_ms", "ops")
+                    if k in r}
+            if r.get("trace") is not None:
+                args["trace"] = str(r["trace"])
+            events.append({
+                "ph": "X", "cat": "fleet.chunk",
+                "name": f"chunk {r.get('run')}#{r.get('seq')}",
+                "pid": _PID_FLEET_TENANTS,
+                "tid": ten.tid(str(r.get("tenant"))),
+                "ts": ts(r["t0"]), "dur": dur, "args": args})
+            wal_ms = r.get("wal_ms")
+            if isinstance(wal_ms, (int, float)) and wal_ms > 0:
+                # the append's fsync share, right-aligned at the ack
+                events.append({
+                    "ph": "X", "cat": "fleet.wal", "name": "append",
+                    "pid": _PID_FLEET_SVC, "tid": svc.tid("wal"),
+                    "ts": max(ts(r["t1"]) - wal_ms * 1e3, 0.0),
+                    "dur": max(wal_ms * 1e3, 0.001)})
+        elif kind == "launch":
+            args = {k: r[k] for k in
+                    ("cls", "reason", "rows", "capacity",
+                     "occupancy", "device_ms", "certify_ms")
+                    if k in r}
+            args["tenants"] = ",".join(
+                str(t) for t in (r.get("tenants") or []))
+            events.append({
+                "ph": "X", "cat": "fleet.launch",
+                "name": f"{r.get('cls')} [{r.get('reason')}]",
+                "pid": _PID_FLEET_SVC,
+                "tid": svc.tid("device launches"),
+                "ts": ts(r["t0"]), "dur": dur, "args": args})
+            occ = r.get("occupancy")
+            if isinstance(occ, (int, float)):
+                events.append({
+                    "ph": "C", "name": "batch occupancy",
+                    "pid": _PID_FLEET_SVC,
+                    "tid": svc.tid("batch occupancy"),
+                    "ts": ts(r["t0"]),
+                    "args": {str(r.get("cls")): float(occ)}})
+            # the decision log: WHY this launch fired, as an instant
+            # on the scheduler swimlane
+            events.append({
+                "ph": "i", "cat": "fleet.decision", "s": "t",
+                "name": str(r.get("reason")),
+                "pid": _PID_FLEET_SVC, "tid": svc.tid("scheduler"),
+                "ts": ts(r["t0"])})
+        elif kind == "verdict":
+            lat = r.get("latency") or {}
+            args = {k: v for k, v in lat.items()
+                    if isinstance(v, (int, float))
+                    and not isinstance(v, bool)}
+            if lat.get("replay"):
+                args["replay"] = 1
+            events.append({
+                "ph": "X", "cat": "fleet.verdict",
+                "name": f"verdict {r.get('run')}",
+                "pid": _PID_FLEET_TENANTS,
+                "tid": ten.tid(str(r.get("tenant"))),
+                "ts": ts(r["t0"]), "dur": dur, "args": args})
+    return {"traceEvents": events,
+            "displayTimeUnit": "ms",
+            "otherData": {"source": "jepsen_tpu.fleet.flightrec"}}
 
 
 def validate_chrome_trace(doc: dict) -> int:

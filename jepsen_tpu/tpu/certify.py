@@ -1103,7 +1103,11 @@ def attach_wgl(model, hist, enc, result) -> dict:
         result["certificate"] = absent("extraction disabled "
                                        "(JEPSEN_TPU_CERTIFY=0)")
         return result
-    cert = wgl_certificate(model, hist, enc, result)
+    # spanned so the fleet flight recorder can price certification
+    # separately from device compute (flightrec.kernel_phases joins
+    # this span against the launch window)
+    with telemetry.span("certify.attach"):
+        cert = wgl_certificate(model, hist, enc, result)
     result["certificate"] = cert
     telemetry.count("certify.absent" if "absent" in cert
                     else "certify.extracted")

@@ -354,6 +354,24 @@ def annotate(**attrs) -> None:
     _global.annotate(**attrs)
 
 
+def current_context() -> dict | None:
+    """The calling thread's innermost span as a COMPACT propagatable
+    context ({"trace", "span"}), or None outside any span. This is
+    the cross-process propagation seam: the fleet client stamps it
+    into the wire `tc` field so server-side flight-recorder spans
+    (jepsen_tpu.fleet.flightrec) link back to the run's own optrace
+    — one causal chain from the op that produced a chunk to the
+    device launch that checked it."""
+    cur = _global.current()
+    if not isinstance(cur, dict):
+        return None
+    out = {}
+    for k in ("trace", "span"):
+        if cur.get(k) is not None:
+            out[k] = cur[k]
+    return out or None
+
+
 # ---------------------------------------------------------------------------
 # Reading + validating stored artifacts
 # ---------------------------------------------------------------------------

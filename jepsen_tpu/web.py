@@ -40,6 +40,9 @@ logger = logging.getLogger(__name__)
 SSE_POLL_S = 0.25
 SSE_HEARTBEAT_S = 10.0
 SSE_MAX_S = 6 * 3600.0
+# the /fleet live charts poll the fleet server itself, so they tick
+# slower than the file-tail cadence above
+FLEET_SSE_POLL_S = 2.0
 
 
 def fast_tests(base: Path | None = None) -> list:
@@ -281,6 +284,117 @@ def _fleet_stats(base: Path):
         return None, f"fleet at {addr} unreachable: {e}"
 
 
+def fleet_event_payload(st: dict) -> dict:
+    """One SSE sample for the /fleet page's live charts: the flight
+    recorder's headline latency quantiles, per-class batch occupancy,
+    and the decision-log counts (jepsen_tpu.fleet.flightrec)."""
+    fr = (st or {}).get("flightrec") or {}
+    out: dict = {"enabled": bool(fr.get("enabled"))}
+    if not out["enabled"]:
+        return out
+    for key in ("verdict_ms", "ack_ms"):
+        d = fr.get(key) or {}
+        out[key] = {q: d.get(q) for q in ("p50", "p99")}
+    out["occupancy"] = {c: (v or {}).get("occupancy")
+                        for c, v in (fr.get("classes") or {}).items()}
+    out["launches"] = fr.get("launches", 0)
+    out["decisions"] = fr.get("decisions") or {}
+    return out
+
+
+# the /fleet page's live section: latency sparkline + occupancy
+# timeline fed by the SSE endpoint (/fleet?events=1)
+_FLEET_LIVE_JS = (
+    "<h3>live</h3>"
+    "<p>verdict p99 ms <canvas id='lat' width='360' height='48'>"
+    "</canvas> &nbsp; batch occupancy <canvas id='occ' width='360'"
+    " height='48'></canvas></p>"
+    "<script>\n"
+    "var lat = [], occS = [], occF = [];\n"
+    "function draw(cv, series, max) {\n"
+    "  var c = cv.getContext('2d'), w = cv.width, h = cv.height;\n"
+    "  c.clearRect(0, 0, w, h);\n"
+    "  series.forEach(function (s) {\n"
+    "    if (!s.pts.length) return;\n"
+    "    c.strokeStyle = s.color; c.beginPath();\n"
+    "    s.pts.forEach(function (v, i) {\n"
+    "      var x = i * w / Math.max(s.pts.length - 1, 1);\n"
+    "      var y = h - 2 - (h - 4) * Math.min(v / max, 1);\n"
+    "      i ? c.lineTo(x, y) : c.moveTo(x, y);\n"
+    "    });\n"
+    "    c.stroke();\n"
+    "  });\n"
+    "}\n"
+    "var es = new EventSource('/fleet?events=1');\n"
+    "es.onmessage = function (m) {\n"
+    "  var d = JSON.parse(m.data);\n"
+    "  if (!d.enabled) return;\n"
+    "  if (d.verdict_ms && d.verdict_ms.p99 != null)\n"
+    "    lat.push(d.verdict_ms.p99);\n"
+    "  occS.push((d.occupancy && d.occupancy['slice']) || 0);\n"
+    "  occF.push((d.occupancy && d.occupancy['final']) || 0);\n"
+    "  [lat, occS, occF].forEach(function (a) {\n"
+    "    while (a.length > 120) a.shift(); });\n"
+    "  draw(document.getElementById('lat'),\n"
+    "       [{pts: lat, color: '#1668dc'}],\n"
+    "       Math.max.apply(null, lat.concat([1])));\n"
+    "  draw(document.getElementById('occ'),\n"
+    "       [{pts: occS, color: '#2aa198'},\n"
+    "        {pts: occF, color: '#d33682'}], 1);\n"
+    "};\n"
+    "</script>")
+
+
+def _flightrec_html(fr: dict) -> str:
+    """The /fleet page's flight-recorder section (doc/fleet.md, 'The
+    fleet flight recorder')."""
+    if not fr.get("enabled"):
+        return ("<h2>flight recorder</h2><p><em>disabled "
+                "(FleetServer(flightrec=False))</em></p>")
+
+    def cell(d, q):
+        v = (d or {}).get(q)
+        return "–" if v is None else f"{v:g}"
+
+    def qrow(label, d):
+        return (f"<tr><td>{label}</td>"
+                + "".join(f"<td>{cell(d, q)}</td>"
+                          for q in ("p50", "p95", "p99"))
+                + f"<td>{(d or {}).get('n', 0)}</td></tr>")
+
+    tenant_rows = "".join(
+        f"<tr><td>{_html.escape(t)}</td>"
+        f"<td>{cell(v.get('verdict_ms'), 'p50')}</td>"
+        f"<td>{cell(v.get('verdict_ms'), 'p99')}</td>"
+        f"<td>{cell(v.get('ack_ms'), 'p99')}</td></tr>"
+        for t, v in sorted((fr.get("tenants") or {}).items()))
+    cls_rows = "".join(
+        f"<tr><td>{_html.escape(c)}</td>"
+        f"<td>{v.get('launches', 0)}</td>"
+        f"<td>{v.get('rows_per_launch', 0)}</td>"
+        f"<td>{round(100 * (v.get('occupancy') or 0.0), 1)}%</td>"
+        "</tr>"
+        for c, v in sorted((fr.get("classes") or {}).items()))
+    dec = fr.get("decisions") or {}
+    idle = fr.get("idle") or {}
+    return (
+        "<h2>flight recorder</h2>"
+        "<table><tr><th>latency</th><th>p50</th><th>p95</th>"
+        "<th>p99</th><th>n</th></tr>"
+        + qrow("verdict ms", fr.get("verdict_ms"))
+        + qrow("ack ms", fr.get("ack_ms"))
+        + "</table><table><tr><th>tenant</th><th>verdict p50</th>"
+        "<th>verdict p99</th><th>ack p99</th></tr>" + tenant_rows
+        + "</table><table><tr><th>class</th><th>launches</th>"
+        "<th>rows/launch</th><th>occupancy</th></tr>" + cls_rows
+        + "</table><p>decisions: "
+        + " · ".join(f"{r} {dec.get(r, 0)}"
+                     for r in ("full", "timeout", "drain", "breaker"))
+        + f" · device idle {idle.get('gaps', 0)} gaps, "
+        f"{idle.get('total_ms', 0.0)} ms</p>"
+        + _FLEET_LIVE_JS)
+
+
 def fleet_html(base: Path | None = None) -> str:
     """The fleet status page: service counters, per-tenant quota use,
     live streaming-check state, scheduler batching stats
@@ -332,7 +446,9 @@ def fleet_html(base: Path | None = None) -> str:
             + "</table><h2>live streaming checks</h2>"
             "<table><tr><th>tenant/run</th><th>state</th>"
             "<th>checked-frac</th><th>ops</th></tr>" + streams
-            + "</table></body></html>")
+            + "</table>"
+            + _flightrec_html(st.get("flightrec") or {})
+            + "</body></html>")
 
 
 def anomaly_index(res, prefix: str = "", depth: int = 0) -> list:
@@ -899,6 +1015,23 @@ class StoreHandler(BaseHTTPRequestHandler):
             if f is not None:
                 f.close()
 
+    def _fleet_sse(self) -> None:
+        """Streams flight-recorder samples for the /fleet page's live
+        charts (fleet_event_payload): one JSON `data:` message per
+        poll of the fleet server's stats."""
+        self.send_response(200)
+        self.send_header("Content-Type", "text/event-stream")
+        self.send_header("Cache-Control", "no-cache")
+        self.end_headers()
+        deadline = time.monotonic() + SSE_MAX_S
+        while time.monotonic() < deadline:
+            st, _info = _fleet_stats(self.base)
+            payload = fleet_event_payload(st or {})
+            self.wfile.write(b"data: "
+                             + json.dumps(payload).encode() + b"\n\n")
+            self.wfile.flush()
+            time.sleep(FLEET_SSE_POLL_S)
+
     def do_GET(self):  # noqa: N802
         split = urlsplit(self.path)
         path = unquote(split.path)
@@ -979,8 +1112,12 @@ class StoreHandler(BaseHTTPRequestHandler):
             elif path == "/fleet" or path == "/fleet/":
                 # checking-as-a-service status (jepsen_tpu.fleet):
                 # reads <base>/fleet/fleet.addr and asks the live
-                # server for its per-tenant stats
-                self._send(200, fleet_html(self.base).encode())
+                # server for its per-tenant stats; ?events=1 is the
+                # flight-recorder SSE feed for the live charts
+                if query.get("events"):
+                    self._fleet_sse()
+                else:
+                    self._send(200, fleet_html(self.base).encode())
             elif path == "/coverage" or path.startswith("/coverage/"):
                 # the cross-run fault × workload × anomaly heatmap
                 # (jepsen_tpu.coverage); /coverage/<fault>/<workload>

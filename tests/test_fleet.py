@@ -23,10 +23,12 @@ from jepsen_tpu import chaos, core, ledger, telemetry, testing
 from jepsen_tpu import generator as gen
 from jepsen_tpu.checker import models
 from jepsen_tpu.fleet import client as fclient
+from jepsen_tpu.fleet import flightrec
 from jepsen_tpu.fleet import scheduler as fsched
 from jepsen_tpu.fleet import server as fserver
 from jepsen_tpu.fleet import wal as fwal
 from jepsen_tpu.fleet import wire
+from jepsen_tpu.reports import trace as rtrace
 from jepsen_tpu.history import History, op as make_op
 from jepsen_tpu.tpu import certify, synth, wgl
 
@@ -683,11 +685,47 @@ class TestMultiTenantE2E:
             for t, h in hists.items():
                 assert_verdict_matches_solo(h, out[t]["result"],
                                             solo_verdict(h))
-            st = srv.stats()["scheduler"]
+            stats = srv.stats()
+            st = stats["scheduler"]
             # continuous batching actually happened ACROSS tenants
             assert st["cross_tenant_launches"] >= 1
             assert st["max_tenants_in_launch"] >= 2
             assert st["final_hists"] == 8
+            # launch classes split: the blended hists_per_launch bug
+            assert st["slice_launches"] + st["final_launches"] == \
+                st["launches"]
+            # the flight recorder's acceptance invariants (ISSUE 17):
+            # a schema-valid latency block on EVERY verdict...
+            for t in hists:
+                lat = out[t].get("latency")
+                flightrec.validate_latency(lat)
+                assert lat["total_ms"] > 0
+            # ...a decision log whose reason counts sum to the total
+            # launches, per-class occupancy in range...
+            fr = stats["flightrec"]
+            assert fr["enabled"] is True
+            assert sum(fr["decisions"].values()) == fr["launches"] \
+                == st["launches"]
+            assert fr["verdict_ms"]["n"] == 8
+            assert set(fr["tenants"]) == set(hists)
+            for cls in ("slice", "final"):
+                assert 0.0 <= fr["classes"][cls]["occupancy"] <= 1.0
+            # ...schema-valid records and a validating Perfetto
+            # fleet-session export with per-tenant + device tracks
+            recs = srv.flightrec.records()
+            flightrec.validate_records(recs)
+            doc = rtrace.fleet_chrome_trace(recs)
+            assert rtrace.validate_chrome_trace(doc) > 0
+            tracks = {e["args"]["name"]
+                      for e in doc["traceEvents"]
+                      if e["ph"] == "M"
+                      and e["name"] == "thread_name"}
+            assert set(hists) <= tracks
+            assert "device launches" in tracks
+            # ...and scrape-parseable tenant-labeled /metrics samples
+            prom = fserver.prometheus_from_stats(stats)
+            assert flightrec.validate_prometheus(prom) > 0
+            assert 'tenant="t3"' in prom
         finally:
             srv.stop()
 
@@ -744,6 +782,179 @@ class TestChaosFleet:
                                             solo_verdict(h))
         finally:
             srv_box[0].stop()
+
+
+# ---------------------------------------------------------------------------
+# the flight recorder in the fleet (ISSUE 17)
+# ---------------------------------------------------------------------------
+
+class TestFlightRecorderFleet:
+    def test_verdict_files_byte_identical_with_recorder_off(
+            self, tmp_path):
+        """The latency block rides NEXT to the verdict, never inside
+        it: the verdict file's bytes must not change with the
+        recorder on vs disabled."""
+        h = seeded_hist(SEED, 200)
+        envs = {}
+        for name, on in (("on", True), ("off", False)):
+            base = tmp_path / name
+            srv = fserver.FleetServer(base, flightrec=on).start()
+            try:
+                envs[name] = stream_run(srv.addr, "t", "r", h)
+            finally:
+                srv.stop()
+        on_b = fwal.verdict_path(tmp_path / "on", "t", "r").read_bytes()
+        off_b = fwal.verdict_path(tmp_path / "off", "t",
+                                  "r").read_bytes()
+        assert on_b == off_b
+        # the wire envelope differs exactly by the latency sibling
+        flightrec.validate_latency(envs["on"]["latency"])
+        assert "latency" not in envs["off"]
+        assert envs["on"]["result"] == envs["off"]["result"]
+
+    def test_chaos_frames_never_orphan_or_double_count_spans(
+            self, tmp_path):
+        """Chaos parity: dropped/duplicated/reordered frames may
+        retransmit forever, but every journaled (tenant, run, seq)
+        records EXACTLY one chunk span — no orphans for dropped
+        frames, no double counts for duplicated ones."""
+        hists = {f"t{i}": seeded_hist(1300 + i, 150)
+                 for i in range(3)}
+        transports = {t: chaos.ChaosFleetTransport(seed=SEED + 7 * i)
+                      for i, t in enumerate(hists)}
+        srv = fserver.FleetServer(tmp_path / "fleet").start()
+        try:
+            out = _concurrent_runs(srv.addr, hists,
+                                   transports=transports, chunk=30)
+            assert sum(sum(tr.tally.values())
+                       for tr in transports.values()) > 0
+            recs = srv.flightrec.records()
+            # validate_records raises on duplicate (tenant, run, seq)
+            flightrec.validate_records(recs)
+            chunk_spans = {(r["tenant"], r["seq"]) for r in recs
+                           if r["kind"] == "chunk"}
+            # Per tenant: seqs form a gapless 1..max run. A dropped
+            # frame that orphaned a span would leave a gap; a
+            # duplicated frame that double-counted would have tripped
+            # validate_records above. (The exact count is schedule-
+            # dependent — a chaos-failed send can resume the staged
+            # chunk or stage a fresh seq — so contiguity, not count,
+            # is the invariant.)
+            for t in hists:
+                seqs = {s for (tt, s) in chunk_spans if tt == t}
+                assert seqs, f"{t}: no chunk spans journaled"
+                assert seqs == set(range(1, max(seqs) + 1)), (
+                    f"{t}: gap in journaled seqs {sorted(seqs)}")
+            for t in hists:
+                flightrec.validate_latency(out[t]["latency"])
+        finally:
+            srv.stop()
+
+    def test_sigkill_replayed_verdicts_carry_replay_blocks(
+            self, tmp_path):
+        """A SIGKILL'd server's replayed verdicts still carry a
+        complete latency block — replay-annotated, with the
+        ingest-side slices honestly zero (they died with the old
+        process)."""
+        h = seeded_hist(SEED, 300)
+        ops = list(h)
+        base = tmp_path / "fleet"
+        sched = fsched.Scheduler()
+        srv = fserver.FleetServer(base, scheduler=sched).start()
+        c = fclient.FleetClient(srv.addr, "t1", "r1", io_timeout_s=1)
+        for i in range(0, len(ops), 50):
+            c.send_chunk(ops[i:i + 50])
+        sched._stop.set()  # freeze: the fin's final check never runs
+        time.sleep(0.4)
+        with pytest.raises(fclient.FleetError):
+            c.finish(timeout_s=2)
+        srv.kill()
+        # restart: recovery re-submits the fin-without-verdict run
+        srv2 = fserver.FleetServer(base).start()
+        try:
+            env = fclient.FleetClient(srv2.addr, "t1", "r1",
+                                      io_timeout_s=3).claim()
+            lat = env["latency"]
+            flightrec.validate_latency(lat)
+            assert lat["replay"] is True
+            assert lat["ingest_wait"] == 0.0
+            assert lat["wal_fsync"] == 0.0
+
+            # the verdict-file-served path (no recompute) also
+            # carries a complete replay block after ANOTHER restart
+            srv2.stop()
+            srv3 = fserver.FleetServer(base).start()
+            env = fclient.FleetClient(srv3.addr, "t1", "r1",
+                                      io_timeout_s=3).claim()
+            flightrec.validate_latency(env["latency"])
+            assert env["latency"]["replay"] is True
+            srv3.stop()
+        finally:
+            pass
+
+    def test_graceful_stop_drains_with_drain_reason(self, tmp_path):
+        """stop() flushes queued work as `drain` launches; every
+        launch still lands in the decision log."""
+        sched = fsched.Scheduler(window_s=30.0)  # never times out
+        srv = fserver.FleetServer(tmp_path / "fleet",
+                                  scheduler=sched).start()
+        h = seeded_hist(SEED, 120)
+        c = fclient.FleetClient(srv.addr, "t", "r", io_timeout_s=3)
+        for i in range(0, 120, 40):
+            c.send_chunk(list(h)[i:i + 40])
+
+        def fin():
+            try:
+                c.finish(timeout_s=30)
+            except fclient.FleetError:
+                pass
+
+        ft = threading.Thread(target=fin, daemon=True)
+        ft.start()
+        time.sleep(0.5)  # the final sits in the 30s batching window
+        srv.stop()
+        ft.join(timeout=10)
+        snap = srv.flightrec.snapshot()
+        assert snap["decisions"]["drain"] >= 1
+        assert sum(snap["decisions"].values()) == snap["launches"]
+
+    def test_snapshot_survives_sigkill_and_folds(self, tmp_path):
+        """flightrec.json persists per verdict; a restarted server
+        folds its predecessor's SLO history back in."""
+        base = tmp_path / "fleet"
+        srv = fserver.FleetServer(base).start()
+        stream_run(srv.addr, "t", "r1", seeded_hist(SEED, 150))
+        before = srv.flightrec.snapshot()["verdict_ms"]["n"]
+        assert before >= 1
+        srv.kill()
+        srv2 = fserver.FleetServer(base).start()
+        try:
+            s = srv2.flightrec.snapshot()
+            assert s["verdict_ms"]["n"] == before
+            assert s["tenants"]["t"]["verdict_ms"]["n"] == before
+        finally:
+            srv2.stop()
+
+    def test_client_ack_histogram_rides_result_summary(self):
+        import tempfile
+
+        with tempfile.TemporaryDirectory() as td:
+            srv = fserver.FleetServer(td).start()
+            try:
+                c = fclient.FleetClient(srv.addr, "t", "r",
+                                        io_timeout_s=3)
+                ops = list(seeded_hist(SEED, 100))
+                for i in range(0, 100, 25):
+                    c.send_chunk(ops[i:i + 25])
+                assert c.ack_ms.n == 4
+                streamer = fclient.FleetStreamer(None, c)
+                out = streamer.result_summary(timeout_s=60)
+                assert out["ack_ms"]["n"] == 4
+                assert out["ack_ms"]["p99"] >= out["ack_ms"]["p50"] \
+                    >= 0
+                flightrec.validate_latency(out["verdict"]["latency"])
+            finally:
+                srv.stop()
 
 
 # ---------------------------------------------------------------------------
@@ -922,7 +1133,7 @@ class TestFleetLint:
         from jepsen_tpu.fleet import server as srv
 
         fs = []
-        for mod in (s, srv, c, chaos_mod):
+        for mod in (s, srv, c, chaos_mod, flightrec):
             fs.extend(concurrency.scan_module(mod))
         assert [(f.rule, f.kernel, f.site) for f in fs] == []
 
@@ -932,6 +1143,7 @@ class TestFleetLint:
         names = driver.CONCURRENCY_MODULE_NAMES
         assert "jepsen_tpu.fleet.scheduler" in names
         assert "jepsen_tpu.fleet.server" in names
+        assert "jepsen_tpu.fleet.flightrec" in names
 
     def test_wgl_slices_registered_and_traces(self):
         from jepsen_tpu.analysis import registry

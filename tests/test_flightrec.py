@@ -1,0 +1,385 @@
+"""The fleet flight recorder (ISSUE 17): latency-block schema, record
+schema (chaos-proof chunk-span uniqueness), streaming SLO histograms
+with cross-process persistence, per-class occupancy + decision-log
+accounting, Perfetto fleet-session export, and the Prometheus
+scrape-parse gate.
+
+The fleet-integration side (every verdict carries a schema-valid
+block, decision counts sum to launches, byte-identical verdicts with
+the recorder off) lives in tests/test_fleet.py with the rest of the
+service suite.
+"""
+
+import json
+import threading
+
+import pytest
+
+from jepsen_tpu import telemetry
+from jepsen_tpu.fleet import flightrec as frec
+from jepsen_tpu.monitor import LogHistogram
+from jepsen_tpu.reports import trace as rtrace
+
+
+class _Item:
+    def __init__(self, tenant):
+        self.tenant = tenant
+
+
+# ---------------------------------------------------------------------------
+# latency blocks
+# ---------------------------------------------------------------------------
+
+class TestLatencyBlock:
+    def test_block_schema_and_total(self):
+        b = frec.latency_block(ingest_wait_ms=5.0, wal_fsync_ms=1.0,
+                               queue_wait_ms=2.0,
+                               batching_delay_ms=0.5, encode_ms=3.0,
+                               device_ms=10.0, certify_ms=1.5,
+                               serialize_ms=0.25)
+        frec.validate_latency(b)
+        assert set(b) == set(frec.LATENCY_KEYS) | {"total_ms"}
+        assert b["total_ms"] == pytest.approx(23.25)
+        assert frec.dominant_slice(b) == ("device", 10.0)
+
+    def test_negative_clock_tie_clamps_to_zero(self):
+        b = frec.latency_block(encode_ms=-0.4, device_ms=1.0)
+        frec.validate_latency(b)
+        assert b["encode"] == 0.0
+
+    def test_replay_block_is_schema_valid_and_annotated(self):
+        b = frec.replay_block()
+        frec.validate_latency(b)
+        assert b["replay"] is True
+        assert b["total_ms"] == 0.0
+
+    @pytest.mark.parametrize("bad", [
+        None,
+        {},
+        {k: 0.0 for k in frec.LATENCY_KEYS},            # no total
+        dict(frec.latency_block(), extra=1.0),           # unknown key
+        dict(frec.latency_block(), device=-1.0),         # negative
+        dict(frec.latency_block(), device="1"),          # non-numeric
+        dict(frec.latency_block(), replay=False),        # bad replay
+    ])
+    def test_validate_rejects_malformed(self, bad):
+        with pytest.raises(ValueError):
+            frec.validate_latency(bad)
+
+
+# ---------------------------------------------------------------------------
+# record schema
+# ---------------------------------------------------------------------------
+
+def _chunk_rec(tenant="t0", run="r", seq=1, t0=100, t1=200):
+    return {"kind": "chunk", "tenant": tenant, "run": run, "seq": seq,
+            "t0": t0, "t1": t1, "wal_ms": 0.5, "ack_ms": 1.0,
+            "ops": 10}
+
+
+def _launch_rec(cls="final", reason="timeout", rows=4, cap=64):
+    return {"kind": "launch", "cls": cls, "reason": reason, "t0": 10,
+            "t1": 20, "rows": rows, "capacity": cap,
+            "occupancy": rows / cap, "tenants": ["t0"],
+            "device_ms": 1.0, "certify_ms": 0.1}
+
+
+def _verdict_rec(tenant="t0", run="r"):
+    return {"kind": "verdict", "tenant": tenant, "run": run, "t0": 5,
+            "t1": 50, "latency": frec.latency_block(device_ms=1.0)}
+
+
+class TestRecordSchema:
+    def test_valid_mixture_counts(self):
+        recs = [_chunk_rec(seq=1), _chunk_rec(seq=2), _launch_rec(),
+                _verdict_rec()]
+        assert frec.validate_records(recs) == 4
+
+    def test_duplicate_chunk_span_rejected(self):
+        # the chaos-parity gate: a duplicated/reordered frame that
+        # somehow journaled twice would show up as two spans for one
+        # (tenant, run, seq) — the validator refuses it
+        with pytest.raises(ValueError, match="duplicate chunk"):
+            frec.validate_records([_chunk_rec(seq=3), _chunk_rec(seq=3)])
+
+    def test_same_seq_different_runs_is_fine(self):
+        frec.validate_records([_chunk_rec(run="a"), _chunk_rec(run="b")])
+
+    @pytest.mark.parametrize("rec", [
+        {"kind": "nope", "t0": 0, "t1": 1},
+        {"kind": "chunk", "tenant": "t", "run": "r", "seq": 0,
+         "t0": 0, "t1": 1, "wal_ms": 0, "ack_ms": 0},
+        {"kind": "chunk", "tenant": "t", "run": "r", "seq": 1,
+         "t0": 5, "t1": 4, "wal_ms": 0, "ack_ms": 0},  # t1 < t0
+        dict(_launch_rec(), reason="because"),
+        dict(_launch_rec(), cls="warmup"),
+        dict(_launch_rec(), occupancy=1.5),
+        dict(_launch_rec(), rows=-1),
+        dict(_verdict_rec(), latency=None),
+    ])
+    def test_malformed_rejected(self, rec):
+        with pytest.raises(ValueError):
+            frec.validate_records([rec])
+
+
+# ---------------------------------------------------------------------------
+# the recorder
+# ---------------------------------------------------------------------------
+
+class TestFlightRecorder:
+    def test_disabled_recorder_is_inert(self):
+        fr = frec.FlightRecorder(enabled=False)
+        fr.chunk("t", "r", 1, 0, 10, 5, 3)
+        fr.launch("final", "timeout", 0, 10, 4, 64, [_Item("t")])
+        fr.verdict("t", "r", 0, 10, frec.latency_block())
+        assert fr.records() == []
+        assert fr.snapshot() == {"enabled": False}
+
+    def test_decision_counts_sum_to_launches(self):
+        fr = frec.FlightRecorder()
+        fr.launch("slice", "full", 0, 10, 64, 64, [_Item("a")])
+        fr.launch("slice", "timeout", 20, 30, 8, 64, [_Item("a")])
+        fr.launch("final", "drain", 40, 50, 2, 64,
+                  [_Item("a"), _Item("b")])
+        fr.launch("final", "breaker", 60, 70, 1, 64, [_Item("b")])
+        s = fr.snapshot()
+        assert sum(s["decisions"].values()) == s["launches"] == 4
+        assert s["classes"]["slice"]["launches"] == 2
+        assert s["classes"]["final"]["launches"] == 2
+        # occupancy is per-class packed-rows/capacity, not blended
+        assert s["classes"]["slice"]["occupancy"] == pytest.approx(
+            (1.0 + 8 / 64) / 2)
+        assert s["classes"]["final"]["occupancy"] == pytest.approx(
+            (2 / 64 + 1 / 64) / 2, abs=1e-4)
+        frec.validate_records(fr.records())
+
+    def test_idle_gap_accounting(self):
+        fr = frec.FlightRecorder()
+        ms = 1_000_000  # ns
+        fr.launch("final", "timeout", 0, 10 * ms, 1, 64, [_Item("a")])
+        fr.launch("final", "timeout", 25 * ms, 30 * ms, 1, 64,
+                  [_Item("a")])
+        s = fr.snapshot()
+        assert s["idle"]["gaps"] == 1
+        assert s["idle"]["total_ms"] == pytest.approx(15.0)
+
+    def test_fairness_counters_split_rows_by_item_share(self):
+        fr = frec.FlightRecorder()
+        items = [_Item("a"), _Item("a"), _Item("b")]
+        fr.launch("final", "timeout", 0, 10, 9, 64, items)
+        f = fr.snapshot()["fairness"]
+        assert f["a"] == {"items": 2, "rows": 6, "launches": 1}
+        assert f["b"] == {"items": 1, "rows": 3, "launches": 1}
+
+    def test_chunk_span_extends_to_plausible_client_stamp(self):
+        fr = frec.FlightRecorder()
+        t0 = frec.now()
+        fr.chunk("t", "r", 1, t0, t0 + 1_000_000, 500, 10,
+                 client_t=t0 - 2_000_000)
+        rec = fr.records()[0]
+        assert rec["t0"] == t0 - 2_000_000
+        assert rec["ack_ms"] == pytest.approx(3.0)
+
+    def test_chunk_span_ignores_implausible_client_stamp(self):
+        fr = frec.FlightRecorder()
+        t0 = frec.now()
+        # a different clock domain (way in the past) must not stretch
+        # the span; so must a stamp from the "future"
+        fr.chunk("t", "r", 1, t0, t0 + 1_000_000, 500, 10, client_t=1)
+        fr.chunk("t", "r", 2, t0, t0 + 1_000_000, 500, 10,
+                 client_t=t0 + 5_000_000)
+        assert [r["t0"] for r in fr.records()] == [t0, t0]
+
+    def test_tenant_histograms_and_quantiles(self):
+        fr = frec.FlightRecorder()
+        for i in range(20):
+            fr.verdict("a", f"r{i}", 0, (i + 1) * 1_000_000,
+                       frec.latency_block())
+        s = fr.snapshot()
+        assert s["verdicts"] == 20
+        assert s["verdict_ms"]["n"] == 20
+        assert s["tenants"]["a"]["verdict_ms"]["n"] == 20
+        # log-bucketed estimate lands within one bucket (~9%)
+        assert s["verdict_ms"]["p50"] == pytest.approx(11.0, rel=0.1)
+
+    def test_record_ring_is_bounded(self):
+        fr = frec.FlightRecorder(max_records=8)
+        for i in range(1, 30):
+            fr.chunk("t", "r", i, i, i + 1, 0, 1)
+        assert len(fr.records()) == 8
+
+    def test_save_load_fold_round_trip(self, tmp_path):
+        fr = frec.FlightRecorder()
+        fr.chunk("t", "r", 1, 100, 200, 50, 10)
+        fr.launch("final", "full", 0, 10, 64, 64, [_Item("t")])
+        fr.verdict("t", "r", 0, 7_000_000, frec.latency_block())
+        p = tmp_path / frec.SNAPSHOT_FILE
+        fr.save(p)
+        fr2 = frec.FlightRecorder()
+        assert fr2.load(p) is True
+        s1, s2 = fr.snapshot(), fr2.snapshot()
+        assert s1 == s2
+        # folding the same snapshot again doubles the counters —
+        # histogram merge + counter add, the cross-process observer
+        fr2.load(p)
+        s3 = fr2.snapshot()
+        assert s3["verdicts"] == 2 * s1["verdicts"]
+        assert s3["verdict_ms"]["n"] == 2
+        assert s3["decisions"]["full"] == 2
+
+    def test_load_tolerates_missing_and_torn(self, tmp_path):
+        fr = frec.FlightRecorder()
+        assert fr.load(tmp_path / "nope.json") is False
+        torn = tmp_path / "torn.json"
+        torn.write_text('{"verdicts": 3, "verdict_ms": {"co')
+        assert fr.load(torn) is False
+        assert fr.snapshot()["verdicts"] == 0
+
+    def test_concurrent_saves_never_lose_the_file(self, tmp_path):
+        fr = frec.FlightRecorder()
+        fr.verdict("t", "r", 0, 1_000_000, frec.latency_block())
+        p = tmp_path / frec.SNAPSHOT_FILE
+        errs = []
+
+        def saver():
+            try:
+                for _ in range(50):
+                    fr.save(p)
+            except OSError as e:  # the bug this guards: tmp renamed
+                errs.append(e)   # out from under a racing writer
+
+        ts = [threading.Thread(target=saver) for _ in range(4)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert not errs
+        assert json.loads(p.read_text())["verdicts"] == 1
+
+
+# ---------------------------------------------------------------------------
+# kernel-phase join
+# ---------------------------------------------------------------------------
+
+class TestKernelPhases:
+    def test_joins_kernel_and_certify_spans_in_window(self):
+        telemetry.reset()
+        from jepsen_tpu import util
+
+        r0 = util.relative_time_nanos()
+        with telemetry.span("kernel:wgl-test"):
+            pass
+        with telemetry.span("certify.attach"):
+            pass
+        with telemetry.span("unrelated"):
+            pass
+        r1 = util.relative_time_nanos()
+        device, cert = frec.kernel_phases(r0, r1)
+        assert device > 0
+        assert cert > 0
+        # outside the window: nothing
+        assert frec.kernel_phases(r1 + 10, r1 + 20) == (0.0, 0.0)
+        telemetry.reset()
+
+
+# ---------------------------------------------------------------------------
+# exports: Perfetto + Prometheus
+# ---------------------------------------------------------------------------
+
+class TestExports:
+    def test_fleet_chrome_trace_validates(self):
+        fr = frec.FlightRecorder()
+        ms = 1_000_000
+        for i in range(1, 4):
+            fr.chunk("alpha", "r", i, i * 10 * ms, i * 10 * ms + ms,
+                     ms // 2, 16, trace="abc123")
+        fr.chunk("beta", "r", 1, 5 * ms, 6 * ms, ms // 4, 8)
+        fr.launch("slice", "full", 40 * ms, 50 * ms, 64, 64,
+                  [_Item("alpha"), _Item("beta")], device_ms=5.0)
+        fr.launch("final", "timeout", 60 * ms, 80 * ms, 2, 64,
+                  [_Item("alpha")], device_ms=10.0, certify_ms=1.0)
+        fr.verdict("alpha", "r", 60 * ms, 90 * ms,
+                   frec.latency_block(device_ms=10.0))
+        fr.verdict("beta", "r", 60 * ms, 95 * ms, frec.replay_block())
+        doc = rtrace.fleet_chrome_trace(fr.records())
+        n = rtrace.validate_chrome_trace(doc)
+        assert n > 0
+        evs = doc["traceEvents"]
+        names = {e["args"]["name"] for e in evs
+                 if e["ph"] == "M" and e["name"] == "thread_name"}
+        # one track per tenant + the service swimlanes
+        assert {"alpha", "beta", "device launches", "wal",
+                "scheduler"} <= names
+        # decision instants mirror the launches
+        assert sorted(e["name"] for e in evs if e["ph"] == "i") == \
+            ["full", "timeout"]
+        # occupancy counter per class
+        cvals = [e["args"] for e in evs
+                 if e["ph"] == "C" and e["name"] == "batch occupancy"]
+        assert {"slice": 1.0} in cvals
+        # timestamps rebased to the earliest record
+        assert min(e["ts"] for e in evs if e["ph"] != "M") == 0.0
+
+    def test_fleet_chrome_trace_empty_records(self):
+        doc = rtrace.fleet_chrome_trace([])
+        assert rtrace.validate_chrome_trace(doc) >= 0
+
+    def test_prometheus_validator(self):
+        good = ('# HELP x y\n'
+                'jepsen_fleet_verdict_latency_ms{q="p99"} 12.5\n'
+                'jepsen_fleet_tenant_ack_latency_ms'
+                '{tenant="a",q="p50"} 0.25\n'
+                'jepsen_fleet_decisions_total{reason="timeout"} 3\n'
+                'jepsen_fleet_launches 4\n')
+        assert frec.validate_prometheus(good) == 4
+        with pytest.raises(ValueError):
+            frec.validate_prometheus('jepsen bad line\n')
+        with pytest.raises(ValueError):
+            frec.validate_prometheus(
+                'jepsen_fleet_x{tenant=unquoted} 1\n')
+
+
+# ---------------------------------------------------------------------------
+# CLI / web renderers (pure text-from-dict)
+# ---------------------------------------------------------------------------
+
+def _stats_fixture():
+    fr = frec.FlightRecorder()
+    fr.chunk("a", "r", 1, 0, 2_000_000, 1_000_000, 10)
+    fr.launch("final", "timeout", 0, 5_000_000, 4, 64,
+              [_Item("a")])
+    fr.verdict("a", "r", 0, 9_000_000, frec.latency_block())
+    return {"streams": 1, "chunks": 1, "verdicts": 1,
+            "scheduler": {"launches": 1},
+            "flightrec": fr.snapshot()}
+
+
+class TestRenderers:
+    def test_fleet_top_lines(self):
+        from jepsen_tpu import cli
+
+        lines = cli._fleet_top_lines(_stats_fixture())
+        text = "\n".join(lines)
+        assert "verdict ms" in text
+        assert "a" in text
+        assert "final" in text
+        assert "timeout=1" in text
+        # disabled recorder renders honestly
+        lines = cli._fleet_top_lines({"flightrec": {"enabled": False}})
+        assert any("disabled" in ln for ln in lines)
+
+    def test_web_event_payload_and_section(self):
+        from jepsen_tpu import web
+
+        st = _stats_fixture()
+        payload = web.fleet_event_payload(st)
+        assert payload["enabled"] is True
+        assert payload["launches"] == 1
+        assert payload["occupancy"]["final"] == pytest.approx(
+            4 / 64, abs=1e-4)
+        assert json.loads(json.dumps(payload)) == payload
+        assert web.fleet_event_payload({}) == {"enabled": False}
+        html = web._flightrec_html(st["flightrec"])
+        assert "flight recorder" in html
+        assert "EventSource" in html
+        assert "disabled" in web._flightrec_html({"enabled": False})

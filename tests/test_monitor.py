@@ -91,6 +91,72 @@ class TestLogHistogram:
         q = h2.quantile(0.5)
         assert 1e6 / LogHistogram.GROWTH <= q <= 1e6 * LogHistogram.GROWTH
 
+    def test_dict_round_trip_preserves_quantiles(self):
+        """to_dict -> JSON -> from_dict is lossless: buckets, zeros,
+        and every quantile survive, and n is re-derived from the
+        buckets rather than trusted (the flight recorder's
+        persistence contract)."""
+        rng = random.Random(11)
+        h = LogHistogram()
+        for _ in range(2000):
+            h.add(rng.lognormvariate(10, 3))
+        h.add(0, n=7)
+        d = json.loads(json.dumps(h.to_dict()))
+        h2 = LogHistogram.from_dict(d)
+        assert h2.counts == h.counts
+        assert h2.zeros == h.zeros == 7
+        assert h2.n == h.n == h2.zeros + sum(h2.counts.values())
+        for q in (0.1, 0.5, 0.9, 0.95, 0.99):
+            assert h2.quantile(q) == h.quantile(q)
+
+    def test_from_dict_tolerates_junk(self):
+        """A torn/corrupt snapshot folds as EMPTY, never raises —
+        the crash-tolerance contract of flightrec.json."""
+        for junk in (None, [], "x", {"counts": "nope"},
+                     {"counts": {"a": "b"}}, {"zeros": "many"},
+                     {"counts": {"3": -2, "4": 0}}):
+            h = LogHistogram.from_dict(junk)
+            assert (h.n, h.zeros, h.counts) == (0, 0, {})
+        # negative/zero bucket counts are dropped, positives kept
+        h = LogHistogram.from_dict({"counts": {"3": 2}, "zeros": -1})
+        assert h.zeros == 0 and h.n == 2
+
+    def test_merge_dicts_matches_pairwise_merge(self):
+        """merge_dicts folds serialized histograms to the same result
+        as pairwise merge in any order — the cross-process fold a
+        restarted fleet server (or an external observer) does."""
+        rng = random.Random(5)
+        hs = []
+        for _ in range(4):
+            h = LogHistogram()
+            for _ in range(300):
+                h.add(rng.lognormvariate(12, 2))
+            hs.append(h)
+        dicts = [h.to_dict() for h in hs]
+        folded = LogHistogram.merge_dicts(dicts)
+        folded_rev = LogHistogram.merge_dicts(reversed(dicts))
+        pair = hs[0].merge(hs[1]).merge(hs[2]).merge(hs[3])
+        assert folded.counts == folded_rev.counts == pair.counts
+        assert folded.n == folded_rev.n == pair.n == 1200
+        for q in (0.5, 0.99):
+            assert folded.quantile(q) == pair.quantile(q)
+
+    def test_quantiles_vs_numpy_after_round_trip(self):
+        """Serialization cannot cost accuracy: the round-tripped
+        histogram stays within one bucket of numpy, same bound as
+        the live one."""
+        values = np.exp(np.random.RandomState(9).normal(13, 2, 3000))
+        h = LogHistogram()
+        for v in values:
+            h.add(float(v))
+        h2 = LogHistogram.from_dict(
+            json.loads(json.dumps(h.to_dict())))
+        for q in (0.5, 0.95, 0.99):
+            est = h2.quantile(q)
+            true = float(np.quantile(values, q, method="lower"))
+            assert abs(LogHistogram.bucket_of(est)
+                       - LogHistogram.bucket_of(true)) <= 1, (q, est)
+
 
 # ---------------------------------------------------------------------------
 # Monitor unit behavior
