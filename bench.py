@@ -1062,6 +1062,63 @@ def bench_flightrec_overhead(n_runs=4, ops_each=600):
     }
 
 
+def bench_checkpoint_extend(n_pairs=8000):
+    """The checkpoint-and-extend BENCH line (doc/robustness.md): a
+    grown run re-checked from the ckpt store pays O(suffix), not
+    O(history). Geometry: a 90% prefix is checked and checkpointed,
+    then the grown (full) history is re-checked two ways — resumed
+    from the prefix record, and from scratch through the same extend
+    entry point (what a torn/stale record honestly degrades to).
+    vs_baseline = full_recheck / suffix_recheck (target >=5x). The
+    checkpoint write itself is timed separately and logged as a
+    fraction of the full check (<2% budget) — durability must not
+    tax the verdict path."""
+    import tempfile
+    from pathlib import Path
+
+    from jepsen_tpu.checker import models
+    from jepsen_tpu.tpu import ckpt as tckpt
+    from jepsen_tpu.tpu import synth, wgl
+
+    model = models.cas_register()
+    ops = list(synth.register_history(n_pairs, seed=7))
+    cut = int(len(ops) * 0.9)
+    cut -= cut % 2  # invoke/complete pairs: keep the cut aligned
+    prefix = ops[:cut]
+    with tempfile.TemporaryDirectory() as td:
+        store = Path(td) / "bench.ckpt"
+        wgl.analysis_extend(model, prefix, store_path=store)
+        seed_bytes = store.read_bytes()
+        wgl.analysis_extend(model, ops, store_path=store)  # warm
+        full = _timed(lambda: wgl.analysis_extend(model, ops))
+        store.write_bytes(seed_bytes)
+        suffix = _timed(
+            lambda: wgl.analysis_extend(model, ops,
+                                        store_path=store))
+        rec = tckpt.read(store)
+        wtmp = Path(td) / "write-probe.ckpt"
+        write_s = _timed(lambda: tckpt.write(wtmp, rec))
+    speedup = full / max(suffix, 1e-9)
+    wfrac = write_s / max(full, 1e-9)
+    if speedup < 5.0:
+        _log(f"!!! checkpoint-extend: suffix re-check only "
+             f"{speedup:.1f}x cheaper (target >=5x)")
+    if wfrac > 0.02:
+        _log(f"!!! checkpoint-extend: checkpoint write {wfrac:.1%} "
+             "of the full check exceeds the 2% budget")
+    _log(f"checkpoint-extend: full {full:.2f}s suffix {suffix:.2f}s "
+         f"({speedup:.1f}x), ckpt write {write_s * 1e3:.1f}ms "
+         f"({wfrac:.2%} of full)")
+    return {
+        "metric": f"checkpoint-extend suffix re-check "
+                  f"({n_pairs}-op grown run, 10% suffix, ckpt write "
+                  f"{wfrac:.2%} of full)",
+        "value": round(suffix, 3),
+        "unit": "s",
+        "vs_baseline": round(speedup, 2),
+    }
+
+
 def bench_analyze_resume(n_ops=2000):
     """analyze --resume wall time (ISSUE 5): a stored run re-analyzed
     offline, resumed vs from scratch. vs_baseline = fresh_time /
@@ -1168,6 +1225,7 @@ _KERNEL_METRICS = (
     ("fleet-throughput", "fleet", True),
     ("fleet-latency", "fleet-latency", False),
     ("flightrec-overhead", "flightrec-overhead", False),
+    ("checkpoint-extend", "ckpt-extend", False),
 )
 
 
@@ -1387,6 +1445,8 @@ def main():
                          (bench_certify_overhead,
                           (50_000 if small else 200_000,)),
                          (bench_analyze_resume, ()),
+                         (bench_checkpoint_extend,
+                          (4000 if small else 8000,)),
                          (bench_fleet_throughput,
                           ((8, 600) if small else (8, 3000))),
                          (bench_flightrec_overhead,

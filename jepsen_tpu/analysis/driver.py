@@ -38,6 +38,10 @@ CONCURRENCY_MODULE_NAMES = (
     "jepsen_tpu.fleet.client",
     "jepsen_tpu.fleet.flightrec",
     "jepsen_tpu.chaos",
+    # checkpoint-and-extend (doc/robustness.md): the store's fault
+    # hook and the streaming elle consumer are both threaded
+    "jepsen_tpu.tpu.ckpt",
+    "jepsen_tpu.tpu.elle",
 )
 
 

@@ -349,6 +349,125 @@ class ChaosFleetTransport:
 
 
 # ---------------------------------------------------------------------------
+# Durability chaos (checkpoint-and-extend, doc/robustness.md)
+# ---------------------------------------------------------------------------
+
+DEFAULT_WAL_FAULT_RATES = {
+    "enospc": 0.05,
+    "eio": 0.05,
+}
+
+DEFAULT_CKPT_FAULT_RATES = {
+    "enospc": 0.05,
+    "eio": 0.05,
+    "torn-ckpt": 0.05,
+    "stale-ckpt": 0.03,
+}
+
+
+class DurabilityChaos:
+    """Seeded durability faults on the fleet's two write-behind paths
+    — WAL appends (fleet.wal.set_fault_hook) and checkpoint writes
+    (tpu.ckpt.set_fault_hook). The invariants (tests/test_fleet.py):
+    the server SHEDS un-journalable chunks with retry-after and an
+    honest degraded stamp (never crashes, never acks bytes it didn't
+    journal), torn/stale checkpoints are detected-and-discarded on
+    read, and the verdict every stream eventually reaches is
+    byte-identical to a solo run's.
+
+      enospc / eio   OSError raised from the write call itself
+      torn-ckpt      the checkpoint lands truncated mid-frame (the
+                     atomic-rename discipline normally prevents this;
+                     the injection simulates a broken filesystem)
+      stale-ckpt     the PREVIOUS checkpoint's bytes land instead of
+                     the new ones (a frozen cache) — valid framing,
+                     wrong frontier: the digest screen must catch it
+
+    Use as a context manager; hooks are process-global, so one rig at
+    a time."""
+
+    _guarded_by_lock = {"_lock": ("_last_ckpt",)}
+
+    def __init__(self, seed=0, wal_rates: dict | None = None,
+                 ckpt_rates: dict | None = None,
+                 tally: Counter | None = None):
+        self.tally = tally if tally is not None else Counter()
+        self._wal_inj = _Injector(
+            seed, ("durability", "wal"),
+            dict(DEFAULT_WAL_FAULT_RATES if wal_rates is None
+                 else wal_rates), self.tally)
+        self._ckpt_inj = _Injector(
+            seed, ("durability", "ckpt"),
+            dict(DEFAULT_CKPT_FAULT_RATES if ckpt_rates is None
+                 else ckpt_rates), self.tally)
+        self._lock = threading.Lock()
+        self._last_ckpt: dict[str, bytes] = {}
+
+    def __enter__(self) -> "DurabilityChaos":
+        from .fleet import wal as fwal
+        from .tpu import ckpt as tckpt
+
+        fwal.set_fault_hook(self._wal_hook)
+        tckpt.set_fault_hook(self._ckpt_hook)
+        return self
+
+    def __exit__(self, *exc) -> None:
+        from .fleet import wal as fwal
+        from .tpu import ckpt as tckpt
+
+        fwal.set_fault_hook(None)
+        tckpt.set_fault_hook(None)
+
+    @staticmethod
+    def _oserror(kind: str) -> OSError:
+        import errno
+
+        code = errno.ENOSPC if kind == "enospc" else errno.EIO
+        return OSError(code, f"chaos: injected {kind}")
+
+    def _wal_hook(self, path, rec) -> None:
+        kind = self._wal_inj.roll()
+        if kind in ("enospc", "eio"):
+            raise self._oserror(kind)
+
+    def _ckpt_hook(self, path, data: bytes) -> bytes:
+        kind = self._ckpt_inj.roll()
+        if kind in ("enospc", "eio"):
+            raise self._oserror(kind)
+        with self._lock:
+            prev = self._last_ckpt.get(str(path))
+            self._last_ckpt[str(path)] = data
+        if kind == "torn-ckpt":
+            return data[:max(len(data) // 2, 1)]
+        if kind == "stale-ckpt" and prev is not None:
+            return prev
+        return data
+
+
+def corrupt_checkpoint(path, mode: str = "torn") -> None:
+    """Damages an on-disk checkpoint file in exactly the ways
+    `tpu.ckpt.read` must detect and discard:
+
+      torn      truncated mid-frame (short payload)
+      garbage   one payload byte flipped (CRC mismatch)
+      magic     the magic scribbled (not a checkpoint at all)
+    """
+    from pathlib import Path
+
+    p = Path(path)
+    buf = bytearray(p.read_bytes())
+    if mode == "torn":
+        buf = buf[:max(len(buf) // 2, 9)]
+    elif mode == "garbage":
+        buf[-1] ^= 0xFF
+    elif mode == "magic":
+        buf[0] ^= 0xFF
+    else:
+        raise ValueError(f"unknown corruption mode {mode!r}")
+    p.write_bytes(bytes(buf))
+
+
+# ---------------------------------------------------------------------------
 # Invariant checks
 # ---------------------------------------------------------------------------
 

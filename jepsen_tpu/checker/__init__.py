@@ -333,19 +333,48 @@ class Linearizable(Checker):
     def check(self, test, hist, opts=None):
         from ..tpu import wgl
 
+        store_dir = test.get("store_dir") \
+            if isinstance(test, dict) else None
+        if store_dir and test.get("extend?") \
+                and self.algorithm == "tpu":
+            # checkpoint-and-extend (doc/robustness.md): re-checking
+            # the grown run-dir reuses the persisted frontier, paying
+            # O(suffix); a stale/absent record falls through to the
+            # full check inside analysis_extend
+            out = self._trim(wgl.analysis_extend(
+                self.model, hist,
+                store_path=self._extend_path(store_dir, hist),
+                certify=self.certify))
+            return self._explain(test, out)
         ckpt_dir = None
-        if isinstance(test, dict) and test.get("checkpoint?") \
-                and test.get("store_dir"):
+        if store_dir and test.get("checkpoint?"):
             from pathlib import Path
 
             # a DIRECTORY: each check derives a per-fingerprint file,
             # so concurrent per-key/composed checkers never collide
-            ckpt_dir = Path(test["store_dir"]) / "checker-frontier"
+            ckpt_dir = Path(store_dir) / "checker-frontier"
         out = self._trim(wgl.analysis(self.model, hist,
                                       algorithm=self.algorithm,
                                       checkpoint_dir=ckpt_dir,
                                       certify=self.certify))
         return self._explain(test, out)
+
+    def _extend_path(self, store_dir, hist):
+        """Per-(model, history-identity) store file under the run
+        dir's ckpt/: keyed by the model repr and the FIRST op (stable
+        as the run grows by appending), so concurrent per-key checks
+        never share — and never thrash — one record."""
+        import hashlib
+
+        from ..store import format as fmt
+        from ..tpu import ckpt
+
+        h = hashlib.sha256(repr(self.model).encode())
+        first = next(iter(hist), None)
+        if first is not None:
+            h.update(fmt.encode_op(first))
+        return ckpt.run_dir_path(store_dir,
+                                 f"wgl-{h.hexdigest()[:16]}")
 
     @staticmethod
     def _explain(test, out: dict) -> dict:

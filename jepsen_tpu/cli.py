@@ -684,7 +684,7 @@ def _fleet_top_lines(stats: dict) -> list[str]:
     dec = fr.get("decisions") or {}
     lines.append("decisions  " + "  ".join(
         f"{r}={dec.get(r, 0)}" for r in
-        ("full", "timeout", "drain", "breaker")))
+        ("full", "timeout", "drain", "breaker", "quarantine")))
     idle = fr.get("idle") or {}
     lines.append(f"device idle  {idle.get('gaps', 0)} gaps, "
                  f"{idle.get('total_ms', 0.0):.1f} ms total")
@@ -702,15 +702,18 @@ def fleet_cmd() -> dict:
       fleet top              live SLO/utilization view (flight rec.)
       fleet explain <run>    a verdict's latency decomposition
       fleet trace            write the Perfetto fleet-session view
+      fleet ckpt <path>      inspect a checkpoint record (or a
+                             <tenant>/<run> under <base>/ckpt)
     """
     def build(p):
         p.add_argument("action", choices=["serve", "submit",
                                           "status", "top", "explain",
-                                          "trace"])
+                                          "trace", "ckpt"])
         p.add_argument("run_dir", nargs="?", default=None,
                        help="submit: a stored run dir (or a "
                             "history.jlog) to stream. explain: the "
-                            "run name whose verdict to decompose.")
+                            "run name whose verdict to decompose. "
+                            "ckpt: a .ckpt path or tenant/run.")
         p.add_argument("--base", default="store/fleet",
                        help="Fleet state dir (WALs, verdicts, "
                             "fleet.addr).")
@@ -826,6 +829,52 @@ def fleet_cmd() -> dict:
                       "predate the crash and read zero)")
             k, v = frec.dominant_slice(lat)
             print(f"dominant slice: {k} ({v:.3f} ms)")
+            return 0
+        if options.action == "ckpt":
+            if not options.run_dir:
+                raise CliError(
+                    "fleet ckpt needs a .ckpt path or tenant/run")
+            from pathlib import Path
+
+            from .tpu import ckpt as tckpt
+
+            p = Path(options.run_dir)
+            if p.suffix != ".ckpt" and not p.exists():
+                # tenant/run shorthand under the fleet base
+                parts = options.run_dir.split("/")
+                if len(parts) == 2:
+                    p = tckpt.fleet_path(options.base, *parts)
+            if not p.exists():
+                raise CliError(f"no checkpoint at {p}")
+            rec = tckpt.read(p)
+            if rec is None:
+                # honest about why the reader refused it — a torn or
+                # schema-invalid record is discarded, never trusted
+                print(f"{p}: torn or invalid checkpoint "
+                      "(discarded on read — a resume from this file "
+                      "falls back to a full re-check)")
+                return 2
+            kind = rec["kind"]
+            print(f"{p}")
+            print(f"  kind    {kind}")
+            print(f"  n_ops   {rec['n_ops']}")
+            print(f"  digest  {rec['digest'][:16]}…")
+            if kind == "stream-wgl":
+                print(f"  model   {rec['model']}")
+                print(f"  checked {rec['checked']}  "
+                      f"mask {rec['mask']:#x}")
+            elif kind == "wgl-extend":
+                print(f"  stride  {rec['stride']}  "
+                      f"cuts {len(rec['cuts'])}  "
+                      f"states {len(rec['states'])}  "
+                      f"masks {len(rec['masks'])}")
+            elif kind == "elle":
+                print(f"  family  {rec['family']}")
+                fro = rec.get("frontier") or {}
+                print(f"  closed  {rec['n_closed']} txns  "
+                      f"keys {len(rec.get('versions') or {})}  "
+                      f"edges {len(fro.get('edges') or [])}  "
+                      f"state {fro.get('state')!r}")
             return 0
         if options.action == "trace":
             from pathlib import Path

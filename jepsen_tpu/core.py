@@ -589,6 +589,11 @@ def run(test: dict) -> dict:
                 # checkers read optrace.jsonl (timeline hover, trace
                 # excerpts): push any buffered records out first
                 tracer.flush()
+                # one guaranteed case-phase sample: the perf checker's
+                # monitor graph reads timeseries.jsonl during analyze,
+                # and a short run may not have crossed the sampler's
+                # first interval yet
+                mon.flush_point()
                 test = analyze(test, store_ctx)
                 # final monitor point BEFORE results.json: /live/
                 # tailers treat results.json as the end-of-run marker

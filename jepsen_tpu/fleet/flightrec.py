@@ -63,10 +63,12 @@ MAX_RECORDS = 4096
 LATENCY_KEYS = ("ingest_wait", "wal_fsync", "queue_wait",
                 "batching_delay", "encode", "device", "certify",
                 "serialize")
-# why a launch fired (the scheduler decision log)
-REASONS = ("full", "timeout", "drain", "breaker")
+# why a launch fired (the scheduler decision log); "quarantine" marks
+# a solo host-lane launch serving a poison-isolated run
+REASONS = ("full", "timeout", "drain", "breaker", "quarantine")
 CLASSES = ("slice", "final")
-RECORD_KINDS = ("chunk", "launch", "verdict")
+RECORD_KINDS = ("chunk", "launch", "verdict", "quarantine")
+QUARANTINE_ACTIONS = ("quarantined", "released")
 QS = (0.5, 0.95, 0.99)
 
 
@@ -206,6 +208,13 @@ def validate_records(records) -> int:
                 validate_latency(r.get("latency"))
             except ValueError as e:
                 raise ValueError(f"record {i}: {e}") from e
+        elif kind == "quarantine":
+            for k in ("tenant", "run", "error"):
+                if not isinstance(r.get(k), str):
+                    raise ValueError(f"record {i}: bad {k!r}")
+            if r.get("action") not in QUARANTINE_ACTIONS:
+                raise ValueError(
+                    f"record {i}: bad action {r.get('action')!r}")
         n += 1
     return n
 
@@ -287,7 +296,8 @@ class FlightRecorder:
     _guarded_by_lock = {"_lock": (
         "_records", "_verdict_ms", "_ack_ms", "_tenant_verdict",
         "_tenant_ack", "_classes", "_decisions", "_fairness",
-        "_idle_ms", "_idle_gaps", "_last_launch_end", "_verdicts")}
+        "_idle_ms", "_idle_gaps", "_last_launch_end", "_verdicts",
+        "_quarantine_events")}
 
     def __init__(self, enabled: bool = True,
                  max_records: int = MAX_RECORDS):
@@ -308,6 +318,7 @@ class FlightRecorder:
         self._idle_gaps = 0
         self._last_launch_end: int | None = None
         self._verdicts = 0
+        self._quarantine_events = {a: 0 for a in QUARANTINE_ACTIONS}
 
     # -- ingest path (server) -------------------------------------------
 
@@ -408,6 +419,24 @@ class FlightRecorder:
                 h = self._tenant_verdict[tenant] = LogHistogram()
             h.add(verdict_ms)
 
+    # -- quarantine path -------------------------------------------------
+
+    def quarantine(self, tenant: str, run: str, action: str,
+                   error: str) -> None:
+        """One poison-isolation transition: a run entering or leaving
+        the solo host lane. Instantaneous events on the recorder
+        clock (t0 == t1)."""
+        if not self.enabled:
+            return
+        t = now()
+        rec = {"kind": "quarantine", "tenant": tenant, "run": run,
+               "action": action, "error": str(error)[:200],
+               "t0": t, "t1": t}
+        with self._lock:
+            self._records.append(rec)
+            self._quarantine_events[action] = \
+                self._quarantine_events.get(action, 0) + 1
+
     # -- views -----------------------------------------------------------
 
     def records(self) -> list[dict]:
@@ -451,6 +480,7 @@ class FlightRecorder:
                 "launches": sum(c["launches"]
                                 for c in self._classes.values()),
                 "decisions": dict(self._decisions),
+                "quarantine": dict(self._quarantine_events),
                 "idle": {"gaps": self._idle_gaps,
                          "total_ms": round(self._idle_ms, 3)},
                 "fairness": {t: dict(f)
@@ -473,6 +503,7 @@ class FlightRecorder:
                 "classes": {c: dict(v)
                             for c, v in self._classes.items()},
                 "decisions": dict(self._decisions),
+                "quarantine": dict(self._quarantine_events),
                 "idle_ms": self._idle_ms,
                 "idle_gaps": self._idle_gaps,
                 "fairness": {t: dict(f)
@@ -506,6 +537,9 @@ class FlightRecorder:
             for r, k in (d.get("decisions") or {}).items():
                 self._decisions[r] = \
                     self._decisions.get(r, 0) + int(k)
+            for a, k in (d.get("quarantine") or {}).items():
+                self._quarantine_events[a] = \
+                    self._quarantine_events.get(a, 0) + int(k)
             self._idle_ms += float(d.get("idle_ms") or 0.0)
             self._idle_gaps += int(d.get("idle_gaps") or 0)
             for t, f in (d.get("fairness") or {}).items():

@@ -43,11 +43,19 @@ from . import flightrec as frec
 
 logger = logging.getLogger(__name__)
 
+
+def _ckpt_mod():
+    from ..tpu import ckpt
+
+    return ckpt
+
+
 MAX_BATCH = 64          # items drained per batch round
 WINDOW_S = 0.02         # how long the loop waits to accumulate work
 QUANTUM = 8.0           # deficit credit per round per unit weight
 BREAKER_THRESHOLD = 3   # consecutive dead batches before opening
 BREAKER_COOLDOWN_S = 5.0
+QUARANTINE_COOLDOWN_S = 30.0  # per-run breaker: solo device probe due
 
 
 class WorkItem:
@@ -121,6 +129,80 @@ class _DeviceBreaker:
             self.opened_at = time.monotonic()  # failed probe re-arms
 
 
+class Quarantine:
+    """Poison-run isolation (doc/robustness.md): when ONE run's
+    history reliably kills shared device launches, quarantining it to
+    a solo host lane keeps every other tenant device-batched — instead
+    of three dead batches opening the FLEET breaker and dragging the
+    whole pool to the host floor. Each quarantined run carries its own
+    tiny breaker: after a cooldown the next visit probes the device
+    SOLO (never inside a shared launch), releasing on success,
+    re-arming on failure. The fleet-wide _DeviceBreaker still owns
+    genuinely systemic failure — it trips only when attribution shows
+    EVERY run in a dead batch failing solo.
+
+    add/probe/release run on the batch thread; snapshot() serves
+    /fleet, prometheus and stats() from other threads — hence the
+    lock."""
+
+    _guarded_by_lock = {"_lock": ("_runs",)}
+
+    def __init__(self, cooldown_s: float = QUARANTINE_COOLDOWN_S):
+        self.cooldown_s = cooldown_s
+        self._lock = threading.Lock()
+        # (tenant, run) -> {"since", "error", "probes", "probe_at"}
+        self._runs: dict[tuple[str, str], dict] = {}
+
+    def add(self, tenant: str, run: str, error: str) -> bool:
+        """Quarantines a run; True when newly quarantined."""
+        with self._lock:
+            if (tenant, run) in self._runs:
+                return False
+            self._runs[(tenant, run)] = {
+                "since": time.time(), "error": error, "probes": 0,
+                "probe_at": time.monotonic() + self.cooldown_s}
+        telemetry.count("fleet.quarantine.added")
+        logger.warning("fleet quarantine: %s/%s -> solo host lane "
+                       "(%s)", tenant, run, error)
+        return True
+
+    def is_quarantined(self, tenant: str, run: str) -> bool:
+        with self._lock:
+            return (tenant, run) in self._runs
+
+    def probe_due(self, tenant: str, run: str) -> bool:
+        with self._lock:
+            st = self._runs.get((tenant, run))
+            return st is not None and \
+                time.monotonic() >= st["probe_at"]
+
+    def record_probe(self, tenant: str, run: str, ok: bool) -> None:
+        """A solo device probe's outcome: success releases the run
+        back to shared launches; failure re-arms its cooldown."""
+        with self._lock:
+            st = self._runs.get((tenant, run))
+            if st is None:
+                return
+            st["probes"] += 1
+            if ok:
+                del self._runs[(tenant, run)]
+            else:
+                st["probe_at"] = time.monotonic() + self.cooldown_s
+        if ok:
+            telemetry.count("fleet.quarantine.released")
+            logger.info("fleet quarantine: %s/%s released after "
+                        "device probe", tenant, run)
+        else:
+            telemetry.count("fleet.quarantine.probe-failed")
+
+    def snapshot(self) -> list[dict]:
+        with self._lock:
+            return [{"tenant": t, "run": r,
+                     "since": st["since"], "error": st["error"],
+                     "probes": st["probes"]}
+                    for (t, r), st in sorted(self._runs.items())]
+
+
 class Scheduler:
     """The batch loop + per-tenant weighted-fair queues."""
 
@@ -146,8 +228,10 @@ class Scheduler:
                        "final_launches": 0, "items": 0,
                        "slice_rows": 0, "final_hists": 0,
                        "cross_tenant_launches": 0,
-                       "max_tenants_in_launch": 0, "host_floor": 0}
+                       "max_tenants_in_launch": 0, "host_floor": 0,
+                       "quarantine_items": 0, "bisect_launches": 0}
         self._breaker = _DeviceBreaker()
+        self.quarantine = Quarantine()
         self._stop = threading.Event()
         self._drain_req = threading.Event()
         self._thread: threading.Thread | None = None
@@ -187,6 +271,7 @@ class Scheduler:
             out = dict(self._stats)
             out["pending"] = self._pending
         out["breaker_open"] = self._breaker.opened_at is not None
+        out["quarantine"] = self.quarantine.snapshot()
         return out
 
     # -- lifecycle -------------------------------------------------------
@@ -336,6 +421,24 @@ class Scheduler:
 
     def _run_slices(self, items: list[WorkItem],
                     reason: str) -> None:
+        # already-quarantined runs never enter the shared launch:
+        # their items go straight to the solo host lane (with a
+        # cooldown-gated device probe), so one poison history can't
+        # keep killing everyone else's batches
+        shared: list[WorkItem] = []
+        solo: dict[tuple[str, str], list[WorkItem]] = {}
+        for i in items:
+            if self.quarantine.is_quarantined(i.tenant, i.run):
+                solo.setdefault((i.tenant, i.run), []).append(i)
+            else:
+                shared.append(i)
+        if shared:
+            self._launch_slices(shared, reason)
+        for (tenant, run), group in solo.items():
+            self._quarantined_slices(tenant, run, group)
+
+    def _launch_slices(self, items: list[WorkItem],
+                       reason: str) -> None:
         from ..tpu import wgl
 
         pairs = [i.payload for i in items]  # (Encoded, start_state)
@@ -359,11 +462,113 @@ class Scheduler:
                 i.finish({"mask": int(mask), "unknown": bool(u)})
         except Exception as e:  # noqa: BLE001 — never wedge a queue
             logger.exception("fleet slice batch failed")
+            self._attribute_slice_failure(items, repr(e))
+
+    def _attribute_slice_failure(self, items: list[WorkItem],
+                                 error: str) -> None:
+        """A dead shared launch: find WHICH run poisoned it by
+        bisecting the batch along run boundaries. Runs whose slices
+        succeed solo get their real masks; a run that fails alone is
+        the poison — quarantine it and serve it from the host lane.
+        Only when EVERY run fails solo is the failure systemic, and
+        only then does the fleet breaker see it."""
+        from ..tpu import wgl
+
+        groups: dict[tuple[str, str], list[WorkItem]] = {}
+        for i in items:
+            groups.setdefault((i.tenant, i.run), []).append(i)
+        keys = list(groups)
+        ok_runs: list[tuple[str, str]] = []
+        bad_runs: list[tuple[str, str]] = []
+
+        def bisect(ks: list[tuple[str, str]]) -> None:
+            sub = [i for k in ks for i in groups[k]]
+            try:
+                with self._lock:
+                    self._stats["bisect_launches"] += 1
+                out, unk = wgl.check_slices([i.payload for i in sub])
+            except Exception:  # noqa: BLE001 — attribution probe
+                if len(ks) == 1:
+                    bad_runs.append(ks[0])
+                    return
+                mid = len(ks) // 2
+                bisect(ks[:mid])
+                bisect(ks[mid:])
+                return
+            ok_runs.extend(ks)
+            for i, mask, u in zip(sub, out, unk):
+                i.finish({"mask": int(mask), "unknown": bool(u)})
+
+        if len(keys) == 1:
+            bad_runs.append(keys[0])
+        else:
+            bisect(keys)
+        telemetry.count("fleet.quarantine.attributions")
+        if not ok_runs and len(keys) > 1:
+            # every run fails solo: the DEVICE is sick, not a history
+            # — this is what the fleet breaker is for
             self._breaker.record(False)
-            for i in items:
-                if not i.done.is_set():
-                    i.finish({"mask": 0, "unknown": True,
-                              "error": repr(e)})
+            for k in keys:
+                for i in groups[k]:
+                    if not i.done.is_set():
+                        i.finish({"mask": 0, "unknown": True,
+                                  "error": error})
+            return
+        if ok_runs:
+            self._breaker.record(True)  # attributed: device is fine
+        for k in bad_runs:
+            self._quarantine_run(k[0], k[1], error)
+            self._quarantined_slices(k[0], k[1], groups[k],
+                                     probe=False)
+
+    def _quarantine_run(self, tenant: str, run: str,
+                        error: str) -> None:
+        if self.quarantine.add(tenant, run, error) \
+                and self.flightrec is not None:
+            self.flightrec.quarantine(tenant, run, "quarantined",
+                                      error)
+
+    def _quarantined_slices(self, tenant: str, run: str,
+                            items: list[WorkItem],
+                            probe: bool = True) -> None:
+        """The solo lane for a quarantined run's slices: a
+        cooldown-gated SOLO device probe first (success releases the
+        run), then the host reach search — exact masks, never wrong,
+        just not sharing anyone's launch."""
+        from ..tpu import wgl
+
+        t0 = frec.now()
+        if probe and self.quarantine.probe_due(tenant, run):
+            try:
+                out, unk = wgl.check_slices(
+                    [i.payload for i in items])
+                self.quarantine.record_probe(tenant, run, True)
+                if self.flightrec is not None:
+                    self.flightrec.quarantine(tenant, run,
+                                              "released", "")
+                for i, mask, u in zip(items, out, unk):
+                    i.finish({"mask": int(mask), "unknown": bool(u)})
+                return
+            except Exception:  # noqa: BLE001 — probe failure re-arms
+                self.quarantine.record_probe(tenant, run, False)
+        for i in items:
+            try:
+                enc, s = i.payload
+                mask = int(wgl.search_host_reach(enc.with_init(s)))
+                i.finish({"mask": mask, "unknown": False})
+            except Exception as e:  # noqa: BLE001 — never wedge
+                i.finish({"mask": 0, "unknown": True,
+                          "error": repr(e)})
+        t1 = frec.now()
+        self._stamp_launch(items, t0, t1, 0.0, 0.0)
+        with self._lock:
+            self._stats["quarantine_items"] += len(items)
+        telemetry.count("fleet.quarantine.host-items", len(items))
+        if self.flightrec is not None:
+            self.flightrec.launch(
+                "slice", "quarantine", t0, t1, rows=len(items),
+                capacity=self.max_batch, items=items,
+                device_ms=0.0, certify_ms=0.0)
 
     def _run_finals(self, items: list[WorkItem],
                     reason: str) -> None:
@@ -385,6 +590,23 @@ class Scheduler:
         from . import build_model, elle_checks
 
         engine = group[0].payload["engine"]
+        # quarantined runs' finals go to the solo host lane too (with
+        # the same cooldown-gated probe), so one poison history can't
+        # kill the whole model group's batched launch
+        if engine == "wgl":
+            solo = [g for g in group
+                    if self.quarantine.is_quarantined(g.tenant,
+                                                      g.run)]
+            if solo:
+                group = [g for g in group if g not in solo]
+                runs: dict[tuple[str, str], list[WorkItem]] = {}
+                for g in solo:
+                    runs.setdefault((g.tenant, g.run), []).append(g)
+                for (tenant, run), sub in runs.items():
+                    self._quarantined_finals(model_name, initial,
+                                             tenant, run, sub)
+            if not group:
+                return
         hists = [g.payload["history"] for g in group]
         # the breaker decision is made HERE, once per group, so the
         # decision log can attribute the launch to it
@@ -418,10 +640,114 @@ class Scheduler:
         except Exception as e:  # noqa: BLE001 — never wedge a queue
             logger.exception("fleet final batch failed (%s)",
                              model_name)
+            if engine == "wgl" and not host:
+                self._attribute_final_failure(model_name, initial,
+                                              group, repr(e))
+            else:
+                self._breaker.record(False)
+                for g in group:
+                    if not g.done.is_set():
+                        g.finish({"valid?": "unknown",
+                                  "error": repr(e)})
+
+    def _attribute_final_failure(self, model_name: str, initial,
+                                 group: list[WorkItem],
+                                 error: str) -> None:
+        """Per-run attribution for a dead finals launch, mirroring
+        _attribute_slice_failure: solo device retries per run; the run
+        that still dies alone is quarantined and served by the host
+        algorithm; all runs dying solo is systemic and goes to the
+        fleet breaker."""
+        from . import build_model
+
+        groups: dict[tuple[str, str], list[WorkItem]] = {}
+        for g in group:
+            groups.setdefault((g.tenant, g.run), []).append(g)
+        keys = list(groups)
+        ok_runs: list[tuple[str, str]] = []
+        bad_runs: list[tuple[str, str]] = []
+        for k in keys:
+            sub = [g for g in groups[k] if not g.done.is_set()]
+            if not sub:
+                ok_runs.append(k)
+                continue
+            try:
+                with self._lock:
+                    self._stats["bisect_launches"] += 1
+                results = self._wgl_finals(
+                    build_model(model_name, initial),
+                    [g.payload["history"] for g in sub], False)
+                for g, r in zip(sub, results):
+                    g.finish(r)
+                ok_runs.append(k)
+            except Exception:  # noqa: BLE001 — attribution probe
+                bad_runs.append(k)
+        telemetry.count("fleet.quarantine.attributions")
+        if not ok_runs and len(keys) > 1:
             self._breaker.record(False)
-            for g in group:
-                if not g.done.is_set():
-                    g.finish({"valid?": "unknown", "error": repr(e)})
+            for k in keys:
+                for g in groups[k]:
+                    if not g.done.is_set():
+                        g.finish({"valid?": "unknown",
+                                  "error": error})
+            return
+        if ok_runs:
+            self._breaker.record(True)
+        else:
+            # a single-run batch that died solo: quarantine serves it
+            # from the host lane; its own probe decides when it may
+            # rejoin the shared pool
+            self._breaker.record(False)
+        for k in bad_runs:
+            self._quarantine_run(k[0], k[1], error)
+            self._quarantined_finals(model_name, initial, k[0], k[1],
+                                     groups[k], probe=False)
+
+    def _quarantined_finals(self, model_name: str, initial,
+                            tenant: str, run: str,
+                            group: list[WorkItem],
+                            probe: bool = True) -> None:
+        """Solo lane for a quarantined run's finals: cooldown-gated
+        device probe, then the pure-host algorithm — the same
+        slower-never-wrong floor the fleet breaker uses, but scoped to
+        ONE run."""
+        from . import build_model
+        from ..tpu import wgl
+
+        group = [g for g in group if not g.done.is_set()]
+        if not group:
+            return
+        model = build_model(model_name, initial)
+        hists = [g.payload["history"] for g in group]
+        t0 = frec.now()
+        if probe and self.quarantine.probe_due(tenant, run):
+            try:
+                results = self._wgl_finals(model, hists, False)
+                self.quarantine.record_probe(tenant, run, True)
+                if self.flightrec is not None:
+                    self.flightrec.quarantine(tenant, run,
+                                              "released", "")
+                for g, r in zip(group, results):
+                    g.finish(r)
+                return
+            except Exception:  # noqa: BLE001 — probe failure re-arms
+                self.quarantine.record_probe(tenant, run, False)
+        for g, h in zip(group, hists):
+            try:
+                g.finish(wgl.analysis(model, h, algorithm="wgl",
+                                      certify=True))
+            except Exception as e:  # noqa: BLE001 — never wedge
+                g.finish({"valid?": "unknown", "error": repr(e)})
+        t1 = frec.now()
+        self._stamp_launch(group, t0, t1, 0.0, 0.0)
+        with self._lock:
+            self._stats["quarantine_items"] += len(group)
+        telemetry.count("fleet.quarantine.host-items", len(group))
+        if self.flightrec is not None:
+            self.flightrec.launch(
+                "final", "quarantine", t0, t1, rows=len(group),
+                capacity=self.max_batch, items=group,
+                device_ms=0.0, certify_ms=0.0)
 
     def _wgl_finals(self, model, hists,
                     host: bool = False) -> list[dict]:
@@ -497,6 +823,10 @@ class StreamingRun:
         self._state = "streaming" if self._model is not None \
             else "unsupported"
         self._inflight = False
+        # set once at attach time (before streaming starts): called
+        # with each stream-wgl checkpoint record after a segment's
+        # mask lands — the server persists it and compacts the WAL
+        self.ckpt_sink = None
 
     def add_ops(self, ops: list) -> None:
         with self._lock:
@@ -515,6 +845,39 @@ class StreamingRun:
             return {"state": self._state,
                     "checked-frac": round(self._frac, 4),
                     "ops": len(self._ops)}
+
+    def seed(self, ops: list, rec: dict | None) -> bool:
+        """Restart recovery: adopts the replayed ops AND, when the
+        checkpoint record proves it describes a prefix of them
+        (kind/model match + ops_digest over the first n_ops), resumes
+        the certified frontier — checked entries, live state mask —
+        so the stream re-checks only the suffix instead of replaying
+        from entry 0. A stale/mismatched record is ignored (counted),
+        never trusted: the stream falls back to a full re-check."""
+        from ..tpu import ckpt
+
+        resumed = False
+        if rec is not None and self._model is not None:
+            ok = (rec.get("kind") == "stream-wgl"
+                  and rec.get("model") == self.model_name
+                  and rec.get("n_ops", 0) <= len(ops)
+                  and ckpt.ops_digest(ops, rec["n_ops"])
+                  == rec.get("digest"))
+            if ok:
+                resumed = True
+                telemetry.count("ckpt.resumed")
+            else:
+                telemetry.count("ckpt.stale")
+        with self._lock:
+            self._ops = list(ops)
+            if resumed:
+                self._checked = int(rec["checked"])
+                self._mask = int(rec["mask"])
+                if self._mask == 0 and self._state == "streaming":
+                    self._state = "tentative-invalid"
+            # everything past the checkpoint is due immediately
+            self._since = max(len(ops), self.STREAM_EVERY)
+        return resumed
 
     # -- the streaming step ---------------------------------------------
 
@@ -589,13 +952,19 @@ class StreamingRun:
             items = [self.scheduler.submit(
                 "slice", self.tenant, self.run, (seg, s))
                 for s in states]
+            # the checkpoint cut in RAW-op coordinates: every entry
+            # below a valid cut completed, so the furthest completion
+            # position bounds the raw prefix the cut certifies
+            raw_cut = int(enc.ret_t[:hi].max()) + 1 if hi > 0 else 0
+            ck = {"n_ops": raw_cut,
+                  "digest": _ckpt_mod().ops_digest(snapshot, raw_cut)}
         except Exception:  # noqa: BLE001 — streaming is advisory
             logger.exception("streaming step failed")
             return settle("unknown")
-        self._collect(items, lo, hi, enc.m)
+        self._collect(items, lo, hi, enc.m, ck)
 
     def _collect(self, items: list[WorkItem], lo: int, hi: int,
-                 total_m: int) -> None:
+                 total_m: int, ck: dict | None = None) -> None:
         new_mask = 0
         unknown = False
         for i in items:
@@ -623,6 +992,20 @@ class StreamingRun:
                 self._state = "tentative-invalid"
                 telemetry.count("fleet.stream.tentative-invalid")
         telemetry.count("fleet.stream.segments")
+        sink = self.ckpt_sink
+        if sink is not None and ck is not None:
+            # checkpoint OUTSIDE the lock: the sink does file I/O
+            # (atomic ckpt write + WAL compaction) and must never
+            # block add_ops/status
+            try:
+                ckpt = _ckpt_mod()
+                sink({"v": ckpt.VERSION, "kind": "stream-wgl",
+                      "model": self.model_name, "checked": hi,
+                      "mask": new_mask, "n_ops": ck["n_ops"],
+                      "digest": ck["digest"]})
+            except Exception:  # noqa: BLE001 — checkpoints are
+                logger.exception("stream checkpoint sink failed")
+                # advisory: a failed write degrades resume, not verdicts
         with self._lock:
             pending = (self._state == "streaming"
                        and self._since >= self.STREAM_EVERY)

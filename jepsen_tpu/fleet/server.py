@@ -41,6 +41,7 @@ from pathlib import Path
 
 from .. import telemetry
 from ..history import History
+from ..tpu import ckpt as tckpt
 from . import elle_checks, wgl_models, wire
 from . import flightrec as frec
 from . import scheduler as fsched
@@ -82,7 +83,7 @@ class RunState:
 
     _guarded_by_lock = {"lock": ("last_seq", "n_ops", "fin",
                                  "verdict", "wal", "t_first", "t_fin",
-                                 "wal_ns", "latency")}
+                                 "wal_ns", "latency", "cum_ops")}
 
     def __init__(self, tenant: str, run: str, model: str,
                  wal: fwal.RunWAL | None, stream=None, initial=None):
@@ -95,6 +96,10 @@ class RunState:
         self.lock = threading.Lock()
         self.last_seq = 0
         self.n_ops = 0
+        # seq -> cumulative op count through that seq: the map that
+        # turns a checkpoint's raw-op cut into the highest WAL seq
+        # safe to compact through
+        self.cum_ops: dict[int, int] = {}
         self.fin = False
         self.touched = time.monotonic()  # last hello/ingest
         self.verdict: dict | None = None
@@ -131,11 +136,14 @@ def prometheus_from_stats(st: dict) -> str:
     for k in ("accepted", "rejected", "chunks", "ops", "verdicts",
               "recovered", "frame_errors", "runs", "active_streams"):
         g(k, st.get(k, 0))
+    g("wal_sheds", st.get("wal_sheds", 0))
     sch = st.get("scheduler") or {}
     for k in ("launches", "slice_launches", "final_launches",
               "items", "slice_rows", "final_hists",
-              "cross_tenant_launches", "pending"):
+              "cross_tenant_launches", "pending",
+              "quarantine_items", "bisect_launches"):
         g(f"scheduler_{k}", sch.get(k, 0))
+    g("quarantined_runs", len(sch.get("quarantine") or []))
     for tenant, ts in sorted((st.get("tenants") or {}).items()):
         lab = '{tenant="%s"}' % tenant
         for k in ("streams", "chunks", "ops", "verdicts",
@@ -167,6 +175,8 @@ def prometheus_from_stats(st: dict) -> str:
             g("class_occupancy", cd.get("occupancy", 0.0), lab)
         for reason, n in sorted((fr.get("decisions") or {}).items()):
             g("decisions_total", n, '{reason="%s"}' % reason)
+        for action, n in sorted((fr.get("quarantine") or {}).items()):
+            g("quarantine_events_total", n, '{action="%s"}' % action)
         idle = fr.get("idle") or {}
         g("device_idle_ms_total", idle.get("total_ms", 0.0))
         g("device_idle_gaps", idle.get("gaps", 0))
@@ -297,6 +307,49 @@ class FleetServer:
             except OSError:
                 pass
 
+    # -- streaming checkpoints (checkpoint-and-extend) -------------------
+
+    def _make_ckpt_sink(self, rs: RunState):
+        """The StreamingRun's checkpoint sink: atomically persist the
+        stream-wgl record, then compact the WAL through the highest
+        seq the certified raw-op cut covers — acked bytes before the
+        cut no longer need one-by-one replay. Both steps are
+        best-effort: a durability fault degrades resume cost, never
+        verdicts (and never the ack path — this runs on the stream's
+        worker thread)."""
+        path = tckpt.fleet_path(self.base, rs.tenant, rs.run)
+
+        def sink(rec: dict) -> None:
+            if not tckpt.try_write(path, rec):
+                return  # counted in ckpt; stale-but-valid file wins
+            cut = rec.get("n_ops", 0)
+            with rs.lock:
+                through = max(
+                    (s for s, c in rs.cum_ops.items() if c <= cut),
+                    default=0)
+                if through and rs.wal is not None:
+                    # compact_through closes/reopens the append fd, so
+                    # it must hold the same lock that serializes
+                    # appends; the rewrite itself is atomic
+                    try:
+                        rs.wal.compact_through(through)
+                    except OSError:
+                        logger.exception("WAL compaction failed")
+
+        return sink
+
+    def _attach_stream(self, rs: RunState):
+        if rs.model in wgl_models():
+            stream = fsched.StreamingRun(rs.model, self.scheduler,
+                                         rs.tenant, rs.run,
+                                         initial=rs.initial)
+        else:
+            from ..tpu import elle as telle
+
+            stream = telle.StreamingElle(rs.model, rs.tenant, rs.run)
+        stream.ckpt_sink = self._make_ckpt_sink(rs)
+        return stream
+
     # -- crash recovery --------------------------------------------------
 
     def recover(self) -> int:
@@ -316,8 +369,31 @@ class FleetServer:
             rs = RunState(tenant, run, model, wal,
                           initial=hello.get("initial"))
             rs.last_seq = folded["last_seq"]
-            rs.n_ops = sum(len(o) for o in folded["chunks"].values())
+            base = folded["base"]
+            cum = len(base["ops"]) if base else 0
+            if base:
+                rs.cum_ops[base["seq"]] = cum
+            floor = base["seq"] if base else 0
+            for seq in range(floor + 1, folded["last_seq"] + 1):
+                cum += len(folded["chunks"][seq])
+                rs.cum_ops[seq] = cum
+            rs.n_ops = cum
             rs.fin = folded["fin"] is not None
+            if not rs.fin and verdict is None and self.stream_checks \
+                    and (model in wgl_models()
+                         or model in elle_checks()):
+                # mid-stream crash: resume the live stream from its
+                # last checkpoint (digest-verified against the
+                # replayed ops) instead of re-checking from entry 0;
+                # a stale/torn/absent checkpoint falls back to full
+                rs.stream = self._attach_stream(rs)
+                kind = "stream-wgl" if model in wgl_models() \
+                    else "elle"
+                rs.stream.seed(
+                    fwal.replay_ops(folded),
+                    tckpt.load(tckpt.fleet_path(self.base, tenant,
+                                                run), kind))
+                rs.stream.step()
             if verdict is not None:
                 rs.verdict = verdict
                 # a recovered-from-file verdict still carries a
@@ -507,13 +583,10 @@ class FleetServer:
                 if rs is None:
                     wal = fwal.RunWAL(
                         fwal.wal_path(self.base, tenant, run))
-                    stream = None
-                    if self.stream_checks and model in wgl_models():
-                        stream = fsched.StreamingRun(
-                            model, self.scheduler, tenant, run,
-                            initial=initial)
-                    rs = RunState(tenant, run, model, wal, stream,
+                    rs = RunState(tenant, run, model, wal,
                                   initial=initial)
+                    if self.stream_checks:
+                        rs.stream = self._attach_stream(rs)
                     hello_rec = {"t": "hello", "tenant": tenant,
                                  "run": run, "model": model,
                                  "weight": weight or 1.0}
@@ -596,13 +669,32 @@ class FleetServer:
                 return
             # WAL BEFORE ack: the ack promises durability
             w0 = frec.now()
-            rs.wal.append({"t": "chunk", "seq": seq, "ops": ops})
+            try:
+                rs.wal.append({"t": "chunk", "seq": seq, "ops": ops})
+            except OSError as e:
+                # durability fault (ENOSPC/EIO, real or chaos): the
+                # chunk was NOT journaled so it must NOT be acked —
+                # shed it with retry-after and an honest degraded
+                # stamp; the client re-sends from its acked seq once
+                # the store recovers. The server never crashes and
+                # never promises durability it doesn't have.
+                telemetry.count("fleet.shed.wal")
+                with self._lock:
+                    self._stats["wal_sheds"] = \
+                        self._stats.get("wal_sheds", 0) + 1
+                wire.send_msg(
+                    conn, {"type": "reject",
+                           "reason": f"durability fault: {e}",
+                           "degraded": True,
+                           "retry_after": self.quotas.retry_after_s})
+                return
             wal_ns = frec.now() - w0
             rs.wal_ns += wal_ns
             if rs.t_first is None:
                 rs.t_first = t_recv
             rs.last_seq = seq
             rs.n_ops += len(ops)
+            rs.cum_ops[seq] = rs.n_ops
             rs.touched = time.monotonic()
             if rs.stream is not None:
                 # under rs.lock so a half-dead old handler racing a
@@ -644,7 +736,23 @@ class FleetServer:
             first_fin = not rs.fin and rs.wal is not None
             if first_fin:
                 w0 = frec.now()
-                rs.wal.append({"t": "fin", "chunks": rs.last_seq})
+                try:
+                    rs.wal.append({"t": "fin",
+                                   "chunks": rs.last_seq})
+                except OSError as e:
+                    # an un-journaled fin must not produce a verdict
+                    # a restarted server wouldn't reproduce: shed
+                    telemetry.count("fleet.shed.wal")
+                    with self._lock:
+                        self._stats["wal_sheds"] = \
+                            self._stats.get("wal_sheds", 0) + 1
+                    wire.send_msg(
+                        conn, {"type": "reject",
+                               "reason": f"durability fault: {e}",
+                               "degraded": True,
+                               "retry_after":
+                                   self.quotas.retry_after_s})
+                    return
                 rs.wal_ns += frec.now() - w0
                 rs.t_fin = frec.now()
                 rs.fin = True
@@ -739,6 +847,15 @@ class FleetServer:
             logger.exception("flightrec snapshot failed")
         rs.verdict_ready.set()
         rs.retire_wal()  # the run can never append again
+        # post-verdict compaction: the historical journal folds to
+        # hello + one base + fin. Replay stays byte-identical (the
+        # crash-replay tests pin this); a fault here costs disk, not
+        # correctness.
+        try:
+            fwal.compact(fwal.wal_path(self.base, rs.tenant, rs.run),
+                         rs.last_seq)
+        except OSError:  # pragma: no cover — compaction is advisory
+            logger.exception("post-verdict WAL compaction failed")
 
     def _claim(self, conn, rs: RunState) -> None:
         deadline = time.monotonic() + VERDICT_TIMEOUT_S

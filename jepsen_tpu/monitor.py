@@ -212,7 +212,11 @@ class Monitor:
         self._stop = threading.Event()
         self._stopped = False
         self._thread: threading.Thread | None = None
-        # previous tick, for rate deltas
+        # previous tick, for rate deltas; the construction time anchors
+        # the FIRST tick, so a run short enough to see only the final
+        # stop() sample still gets a real ops_s (rate since start)
+        # instead of a point the post-hoc graphs must drop
+        self._t0 = util.relative_time_nanos()
         self._last_t: int | None = None
         self._last_completed = 0
         self._last_stalls = 0
@@ -273,8 +277,10 @@ class Monitor:
         now = util.relative_time_nanos()
         tel = telemetry.get()
         with self._lock:
-            dt_s = ((now - self._last_t) / 1e9
-                    if self._last_t is not None else None)
+            base_t = self._last_t if self._last_t is not None \
+                else self._t0
+            dt_s = (now - base_t) / 1e9
+            dt_s = dt_s if dt_s > 0 else None
             d_completed = self._completed - self._last_completed
             d_stalls = self._stalls - self._last_stalls
             self._last_t = now
@@ -324,6 +330,16 @@ class Monitor:
                     logger.exception("monitor point write failed")
                     self._out = None
 
+    def flush_point(self) -> None:
+        """Emit one point now, outside the sampler's cadence. core.run
+        calls this at the case→analyze boundary so the post-hoc graphs
+        always have at least one real-rate sample of the case, even
+        when the run finished inside the first sampler interval."""
+        try:
+            self._emit()
+        except Exception:  # noqa: BLE001 — observability must not sink
+            logger.exception("monitor flush failed")
+
     # -- lifecycle ---------------------------------------------------------
 
     def start(self, out_path=None) -> "Monitor":
@@ -335,6 +351,11 @@ class Monitor:
             except OSError:  # observability must never sink the run;
                 logger.exception("monitor artifact unavailable")
                 self._out = None  # points still accumulate in memory
+        with self._lock:
+            if self._last_t is None:
+                # re-anchor: core.run resets the relative clock between
+                # Monitor construction and start
+                self._t0 = util.relative_time_nanos()
         self._stop.clear()
 
         def run():

@@ -144,6 +144,11 @@ def analyze_run(d, resume: bool = False, test_fn=None,
         # the segmented wgl search reloads its frontier checkpoints
         # (checker-frontier/*.jlog, keyed by history fingerprint)
         test["checkpoint?"] = True
+        # checkpoint-and-extend (doc/robustness.md): linearizable
+        # checkers reuse the run-dir's ckpt/ store, so re-checking a
+        # GROWN run costs O(suffix) — a stale record (the history
+        # changed under the digest) falls back to the full check
+        test["extend?"] = True
 
     # degraded/watchdog sections can't be recomputed offline (no live
     # health registry or watchdog survives the crash) — carry them over

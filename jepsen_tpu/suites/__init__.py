@@ -29,6 +29,7 @@ SUITES = {
     "rethinkdb": "jepsen_tpu.suites.rethinkdb",
     "stolon": "jepsen_tpu.suites.stolon",
     "tidb": "jepsen_tpu.suites.tidb",
+    "voltdb": "jepsen_tpu.suites.voltdb",
     "yugabyte": "jepsen_tpu.suites.yugabyte",
     "zookeeper": "jepsen_tpu.suites.zookeeper",
 }

@@ -1482,6 +1482,198 @@ def _reach_chain(resolved: dict, reaches: list[int], upto: int,
 
 
 # ---------------------------------------------------------------------------
+# Checkpoint-and-extend: incremental re-checking of grown histories
+# ---------------------------------------------------------------------------
+
+# The extend path's FIXED cut stride. check_segmented's adaptive
+# target_len (m//8-ish) moves the cut layout whenever the history
+# grows, which would orphan every checkpointed mask; a fixed stride
+# makes the greedy cut schedule prefix-stable (entries below a valid
+# cut are frozen by real time, so the same cuts — and the same reach
+# masks — fall out of the grown history), which is the whole game.
+EXTEND_STRIDE = 512
+
+
+def _extend_fingerprint(enc: Encoded) -> int:
+    """Model-semantics fingerprint for wgl-extend records: the model
+    class and initial state (via the models' value-based reprs). Entry
+    digests key the HISTORY prefix; this keys the MODEL, so a
+    checkpoint written for a different model (or initial value) never
+    poisons a resume. Deliberately NOT the transition-table bytes:
+    those depend on the whole history's distinct-op set, which grows
+    with the suffix — state identity is carried per-state by the
+    record's "states" reprs instead."""
+    import zlib as _z
+
+    init = enc.states[enc.init_state]
+    return int(_z.crc32(
+        f"{type(init).__name__}:{init!r}".encode()))
+
+
+def _remap_record_masks(record: dict, enc: Encoded,
+                        reused_segments: int
+                        ) -> dict[tuple[int, int], int] | None:
+    """Translates a record's (segment, state) -> mask entries into
+    THIS encoding's state indices. A grown history can discover new
+    distinct ops, which reorders state discovery — indices move, but
+    the states themselves (value-carrying model objects with stable
+    reprs) do not, and a reach mask is semantically a SET of model
+    states. Returns None when any recorded state is unknown to this
+    encoding (not a superset — stale record)."""
+    new_idx = {repr(s): i for i, s in enumerate(enc.states)}
+    old_keys = record["states"]
+    mapping = []
+    for key in old_keys:
+        i = new_idx.get(key)
+        if i is None:
+            return None
+        mapping.append(i)
+    out: dict[tuple[int, int], int] = {}
+    for key, mask in record["masks"].items():
+        k_str, s_str = key.split(":")
+        k, s = int(k_str), int(s_str)
+        if k >= reused_segments or s >= len(mapping):
+            continue
+        new_mask = 0
+        m = int(mask)
+        for j in range(len(mapping)):
+            if (m >> j) & 1:
+                new_mask |= 1 << mapping[j]
+        out[(k, mapping[s])] = new_mask
+    return out
+
+
+def check_extend(enc: Encoded, record: dict | None = None,
+                 stride: int = EXTEND_STRIDE, W: int = 24,
+                 F: int = 48) -> tuple[dict | None, dict | None]:
+    """Segment-composed check with a prefix-stable cut schedule and a
+    reusable (segment, state) -> reach-mask frontier. Returns
+    (result, new_record); (None, None) when the history doesn't
+    segment (caller falls back to the plain paths).
+
+    `record` is a ckpt.py "wgl-extend" record from a previous check of
+    a PREFIX of this history. Reuse is earned, never assumed: the
+    record's cuts must match this history's greedy schedule position by
+    position AND digest by digest (sha256 over the encoded entries
+    below each cut) — so a torn, stale, or wrong-history record
+    degrades to a full re-check, with `ckpt.stale` counted when a
+    record was offered and nothing matched. Masks for the matched
+    prefix segments are reused verbatim; only suffix segments launch.
+    Fresh and resumed runs compose the SAME exact masks through the
+    SAME deterministic composition, so verdicts, search chains — and
+    therefore certificates (certify.attach_wgl derives them from the
+    search chain alone) — are identical by construction."""
+    from . import ckpt as ckpt_mod
+
+    if enc.n_states > 32:
+        return None, None
+    vcuts = valid_cut_points(enc)
+    cuts = segment_cuts(enc, stride, vcuts=vcuts)
+    K = len(cuts) - 1
+    if K < 2:
+        return None, None
+    if 2 * max(cuts[k + 1] - cuts[k] for k in range(K)) >= (1 << 21):
+        return None, None  # a segment alone exceeds the kernel range
+    S = enc.n_states
+    digests = ckpt_mod.entry_digest_chain(enc, cuts)
+    fp = _extend_fingerprint(enc)
+
+    resolved: dict[tuple[int, int], int] = {}
+    reused_segments = 0
+    if record is not None:
+        ok = (record.get("stride") == stride
+              and record.get("model_fp") == fp)
+        matched = 0
+        if ok:
+            rcuts = record["cuts"]
+            rdigs = record["digests"]
+            limit = min(len(rcuts), len(cuts))
+            while matched < limit and rcuts[matched] == cuts[matched] \
+                    and rdigs[matched] == digests[matched]:
+                matched += 1
+        # a segment is reusable when BOTH its cut endpoints matched
+        reused_segments = max(0, matched - 1)
+        remapped = (_remap_record_masks(record, enc, reused_segments)
+                    if reused_segments else None)
+        if remapped:
+            resolved.update(remapped)
+            telemetry.count("ckpt.extend.reused-masks",
+                            len(resolved))
+            telemetry.count("ckpt.extend.resumed")
+        else:
+            reused_segments = 0
+            telemetry.count("ckpt.stale")
+
+    segs = [enc.segment(cuts[k], cuts[k + 1]) for k in range(K)]
+    need = [(k, s) for k in range(K) for s in range(S)
+            if (k, s) not in resolved]
+    if need:
+        out, unk = check_slices([(segs[k], s) for k, s in need],
+                                W, F)
+        for i, (k, s) in enumerate(need):
+            # UNKNOWN rows get the exact host search — resolved masks
+            # are always exact, so resumed composition is bit-stable
+            resolved[(k, s)] = (search_host_reach(
+                segs[k].with_init(s)) if unk[i] else int(out[i]))
+    telemetry.count("ckpt.extend.computed-masks", len(need))
+
+    reach = 1 << enc.init_state
+    reaches = [reach]
+    failed_k = None
+    wstate = 0
+    for k in range(K):
+        nreach = 0
+        for s in range(S):
+            if (reach >> s) & 1:
+                mask = resolved.get((k, s))
+                if mask is None:
+                    # a reused segment can miss a state the old
+                    # encoding never had; the exact host search fills
+                    # it deterministically
+                    mask = int(search_host_reach(
+                        segs[k].with_init(s)))
+                    resolved[(k, s)] = mask
+                nreach |= mask
+        if nreach == 0:
+            failed_k = k
+            wstate = next(s for s in range(S) if (reach >> s) & 1)
+            break
+        reach = nreach
+        reaches.append(reach)
+
+    new_record = {
+        "v": ckpt_mod.VERSION, "kind": "wgl-extend",
+        "stride": int(stride), "model_fp": fp,
+        "cuts": [int(c) for c in cuts], "digests": digests,
+        "states": [repr(s) for s in enc.states],
+        "masks": {f"{k}:{s}": int(m)
+                  for (k, s), m in sorted(resolved.items())},
+        "n_ops": int(enc.m), "digest": digests[-1],
+    }
+    if failed_k is not None:
+        k = failed_k
+        res: dict = {"valid?": False, "failed-segment": k,
+                     "segment-range": [cuts[k], cuts[k + 1]]}
+        chain = _reach_chain(resolved, reaches, k, wstate)
+        if chain is not None:
+            res["search-chain"] = {"cuts": [int(c) for c in cuts],
+                                   "chain": chain}
+        w = search_host(segs[k].with_init(wstate), witness=True)
+        res.update({kk: v for kk, v in w.items() if kk != "valid?"})
+        if "witness-entry" in res:
+            res["witness-entry"] = int(cuts[k] + res["witness-entry"])
+            res["entry-count"] = int(enc.m)
+        return res, new_record
+    final_state = next(s for s in range(S) if (reach >> s) & 1)
+    chain = _reach_chain(resolved, reaches, K, final_state)
+    res = {"valid?": True, "segments": K}
+    if chain is not None:
+        res["search-chain"] = {"cuts": [int(c) for c in cuts],
+                               "chain": chain}
+    return res, new_record
+
+
+# ---------------------------------------------------------------------------
 # Public analysis API (knossos-analysis-shaped results)
 # ---------------------------------------------------------------------------
 
@@ -1601,6 +1793,58 @@ def analysis(model, hist, algorithm: str = "tpu", W: int | None = None,
             from . import certify as certify_mod
 
             certify_mod.attach_wgl(model, hist, enc_box[0], out)
+        return out
+
+
+def analysis_extend(model, hist, store_path=None,
+                    stride: int = EXTEND_STRIDE, W: int | None = None,
+                    F: int | None = None,
+                    certify: bool = False) -> dict:
+    """analysis(), resumable: checks via check_extend's prefix-stable
+    segmentation, loading the previous frontier from the ckpt.py store
+    at `store_path` and persisting the grown frontier back after the
+    verdict. Re-checking a grown history costs O(suffix); a missing,
+    torn, stale, or wrong-model record costs a full re-check — never a
+    wrong verdict. Histories that don't segment (too short, > 32
+    states, unencodable) fall through to plain analysis(), so this is
+    always safe to call where analysis() was."""
+    from . import ckpt as ckpt_mod
+
+    with _ladder_scope() as steps:
+        if not isinstance(hist, History):
+            hist = History(hist)
+        enc = None
+        try:
+            enc = encode(model, hist)
+        except EncodingError:
+            pass
+        out = None
+        new_rec = None
+        if enc is not None:
+            record = None
+            if store_path is not None:
+                record = ckpt_mod.load(store_path, "wgl-extend")
+            out, new_rec = check_extend(
+                enc, record=record, stride=stride,
+                **_seg_kwargs(W, F))
+        if out is None:
+            telemetry.count("ckpt.extend.fallback")
+            return analysis(model, hist, algorithm="tpu", W=W, F=F,
+                            certify=certify)
+        out["analyzer"] = "tpu-extend"
+        _witness_op_indices(out)
+        if steps:
+            out["degradation"] = list(steps)
+        _search_stats(out)
+        if store_path is not None and new_rec is not None:
+            # best-effort durability: a failed write (ENOSPC/EIO)
+            # leaves the previous record in place — degraded, not
+            # wrong — and the verdict still stands
+            ckpt_mod.try_write(store_path, new_rec)
+        if certify:
+            from . import certify as certify_mod
+
+            certify_mod.attach_wgl(model, hist, enc, out)
         return out
 
 

@@ -376,6 +376,7 @@ def _flightrec_html(fr: dict) -> str:
         "</tr>"
         for c, v in sorted((fr.get("classes") or {}).items()))
     dec = fr.get("decisions") or {}
+    qua = fr.get("quarantine") or {}
     idle = fr.get("idle") or {}
     return (
         "<h2>flight recorder</h2>"
@@ -389,7 +390,10 @@ def _flightrec_html(fr: dict) -> str:
         "<th>rows/launch</th><th>occupancy</th></tr>" + cls_rows
         + "</table><p>decisions: "
         + " · ".join(f"{r} {dec.get(r, 0)}"
-                     for r in ("full", "timeout", "drain", "breaker"))
+                     for r in ("full", "timeout", "drain", "breaker",
+                               "quarantine"))
+        + f" · quarantine events {qua.get('quarantined', 0)} in / "
+        f"{qua.get('released', 0)} out"
         + f" · device idle {idle.get('gaps', 0)} gaps, "
         f"{idle.get('total_ms', 0.0)} ms</p>"
         + _FLEET_LIVE_JS)
@@ -426,6 +430,20 @@ def fleet_html(base: Path | None = None) -> str:
         f"<td>{v.get('checked-frac')}</td><td>{v.get('ops')}</td>"
         f"</tr>"
         for k, v in sorted((st.get("streams") or {}).items()))
+    quarantined = sch.get("quarantine") or []
+    qrows = "".join(
+        f"<tr><td>{_html.escape(str(q.get('tenant')))}/"
+        f"{_html.escape(str(q.get('run')))}</td>"
+        f"<td>{q.get('probes', 0)}</td>"
+        f"<td>{_html.escape(str(q.get('error'))[:120])}</td></tr>"
+        for q in quarantined)
+    qsection = (
+        "<h2>quarantined runs</h2>"
+        "<p>poison-isolated to the solo host lane (doc/robustness.md"
+        " — the fleet breaker stays closed for everyone else)</p>"
+        "<table><tr><th>tenant/run</th><th>probes</th>"
+        "<th>error</th></tr>" + qrows + "</table>"
+    ) if quarantined else ""
     return (head
             + f"<p>server at <code>{_html.escape(str(info))}</code>"
             f" · {st.get('runs', 0)} runs · "
@@ -443,7 +461,8 @@ def fleet_html(base: Path | None = None) -> str:
             + "</p><h2>tenants</h2><table><tr><th>tenant</th>"
             "<th>streams</th><th>chunks</th><th>ops</th>"
             "<th>verdicts</th><th>rejected</th></tr>" + rows
-            + "</table><h2>live streaming checks</h2>"
+            + "</table>" + qsection
+            + "<h2>live streaming checks</h2>"
             "<table><tr><th>tenant/run</th><th>state</th>"
             "<th>checked-frac</th><th>ops</th></tr>" + streams
             + "</table>"

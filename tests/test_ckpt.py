@@ -1,0 +1,594 @@
+"""Checkpoint-and-extend tests (doc/robustness.md): the durable
+crash-consistent incremental-checking layer.
+
+The contract under test: a checkpoint is only ever a SPEEDUP. Torn,
+truncated, stale, or wrong-history records are detected and discarded
+— the caller pays for a full re-check, never for a wrong verdict — and
+a resumed check composes the exact masks a from-scratch check would,
+so verdicts AND certificates are byte-identical for valid and invalid
+histories alike. WAL compaction preserves replay byte-for-byte, and a
+crash at any instant during compaction leaves the pre-compaction file
+authoritative."""
+
+import json
+import os
+import time
+
+import pytest
+
+from jepsen_tpu import chaos as jchaos
+from jepsen_tpu import telemetry
+from jepsen_tpu.checker import models
+from jepsen_tpu.fleet import client as fclient
+from jepsen_tpu.fleet import scheduler as fsched
+from jepsen_tpu.fleet import server as fserver
+from jepsen_tpu.fleet import wal as fwal
+from jepsen_tpu.history import History, op as make_op
+from jepsen_tpu.tpu import certify, ckpt as tckpt, elle as telle
+from jepsen_tpu.tpu import synth, wgl
+
+
+def seeded_hist(seed, n=300, corrupt=False):
+    h = synth.register_history(n, seed=seed)
+    if corrupt:
+        h, _ = synth.corrupt_register_history(h)
+    return h
+
+
+def counters():
+    return telemetry.get().counters()
+
+
+def cert_bytes(out):
+    return json.dumps(fwal.json_safe(out["certificate"]),
+                      sort_keys=True)
+
+
+def stream_wgl_rec(ops, checked=10, mask=1):
+    return {"v": tckpt.VERSION, "kind": "stream-wgl",
+            "model": "cas-register", "checked": checked, "mask": mask,
+            "n_ops": len(ops), "digest": tckpt.ops_digest(ops)}
+
+
+# ---------------------------------------------------------------------------
+# the store: framing, schema, corruption, durability faults
+# ---------------------------------------------------------------------------
+
+class TestStore:
+    def test_round_trip_each_kind(self, tmp_path):
+        ops = list(seeded_hist(1, 40))
+        d64 = tckpt.ops_digest(ops)
+        recs = [
+            stream_wgl_rec(ops),
+            {"v": tckpt.VERSION, "kind": "wgl-extend", "n_ops": 40,
+             "digest": d64, "stride": 64, "model_fp": 123,
+             "cuts": [0, 10, 20], "digests": [d64, d64, d64],
+             "states": ["Register(None)"], "masks": {"0:0": 3}},
+            {"v": tckpt.VERSION, "kind": "elle", "n_ops": 40,
+             "digest": d64, "family": "list-append", "n_closed": 7,
+             "versions": {"x": [1, 2]},
+             "frontier": {"state": "streaming", "edges": []}},
+        ]
+        for i, rec in enumerate(recs):
+            p = tmp_path / f"r{i}.ckpt"
+            tckpt.write(p, rec)
+            assert tckpt.read(p) == rec
+            # atomic-rename discipline: no tmp file survives a write
+            assert not p.with_suffix(".tmp").exists()
+
+    def test_schema_rejects_invalid(self, tmp_path):
+        ops = list(seeded_hist(1, 20))
+        good = stream_wgl_rec(ops)
+        for mutate in (
+                lambda r: r.pop("digest"),
+                lambda r: r.update(v=99),
+                lambda r: r.update(kind="mystery"),
+                lambda r: r.update(n_ops=-1),
+                lambda r: r.update(checked=True),
+                lambda r: r.update(digest="short")):
+            rec = dict(good)
+            mutate(rec)
+            with pytest.raises(ValueError):
+                tckpt.validate_record(rec)
+            with pytest.raises(ValueError):
+                tckpt.write(tmp_path / "x.ckpt", rec)
+
+    @pytest.mark.parametrize("mode", ["torn", "garbage", "magic"])
+    def test_corruption_detected_and_discarded(self, tmp_path, mode):
+        telemetry.reset()
+        p = tmp_path / "c.ckpt"
+        tckpt.write(p, stream_wgl_rec(list(seeded_hist(2, 40))))
+        jchaos.corrupt_checkpoint(p, mode)
+        assert tckpt.read(p) is None
+        assert counters().get("ckpt.torn", 0) >= 1
+
+    def test_schema_invalid_payload_counted(self, tmp_path):
+        # valid framing around a schema-violating record: read() must
+        # treat it exactly like a torn file
+        telemetry.reset()
+        import struct
+        import zlib
+
+        p = tmp_path / "bad.ckpt"
+        payload = json.dumps({"v": tckpt.VERSION, "kind": "mystery"})\
+            .encode()
+        p.write_bytes(tckpt.CKPT_MAGIC
+                      + struct.pack("<II", len(payload),
+                                    zlib.crc32(payload)) + payload)
+        assert tckpt.read(p) is None
+        assert counters().get("ckpt.invalid", 0) == 1
+
+    def test_load_screens_kind_digest_nops(self, tmp_path):
+        telemetry.reset()
+        ops = list(seeded_hist(3, 60))
+        p = tmp_path / "s.ckpt"
+        tckpt.write(p, stream_wgl_rec(ops))
+        assert tckpt.load(p, "elle") is None
+        assert counters().get("ckpt.stale", 0) == 0  # wrong kind only
+        # record describes MORE ops than the history at hand: stale
+        assert tckpt.load(p, "stream-wgl",
+                          n_ops=len(ops) - 10) is None
+        # digest mismatch: a different history's prefix
+        other = tckpt.ops_digest(list(seeded_hist(4, 60)))
+        assert tckpt.load(p, "stream-wgl", digest=other) is None
+        assert counters().get("ckpt.stale", 0) == 2
+        rec = tckpt.load(p, "stream-wgl",
+                         digest=tckpt.ops_digest(ops))
+        assert rec is not None and rec["n_ops"] == len(ops)
+
+    def test_missing_file_reads_none(self, tmp_path):
+        assert tckpt.read(tmp_path / "nope.ckpt") is None
+        assert tckpt.load(tmp_path / "nope.ckpt", "elle") is None
+
+    def test_try_write_sheds_on_durability_fault(self, tmp_path):
+        telemetry.reset()
+        ops = list(seeded_hist(5, 40))
+        p = tmp_path / "d.ckpt"
+        first = stream_wgl_rec(ops, checked=5)
+        tckpt.write(p, first)
+
+        def hook(path, data):
+            raise OSError(28, "chaos: injected enospc")
+
+        tckpt.set_fault_hook(hook)
+        try:
+            assert tckpt.try_write(
+                p, stream_wgl_rec(ops, checked=9)) is False
+        finally:
+            tckpt.set_fault_hook(None)
+        assert counters().get("ckpt.write-error", 0) == 1
+        # the previous (valid) checkpoint survives the failed write
+        assert tckpt.read(p) == first
+
+    def test_fleet_path_rejects_unsafe_names(self, tmp_path):
+        with pytest.raises(AssertionError):
+            tckpt.fleet_path(tmp_path, "../evil", "r")
+
+
+# ---------------------------------------------------------------------------
+# checkpointed vs from-scratch: the pinned equivalence
+# ---------------------------------------------------------------------------
+
+class TestExtendEquivalence:
+    @pytest.mark.parametrize("corrupt", [False, True],
+                             ids=["valid", "invalid"])
+    def test_resume_identical_to_from_scratch(self, tmp_path,
+                                              corrupt):
+        """A check resumed from a prefix checkpoint reaches the SAME
+        verdict and the SAME certificate bytes as a from-scratch check
+        of the grown history — for valid and invalid histories."""
+        telemetry.reset()
+        model = models.cas_register()
+        ops = list(seeded_hist(11, 600, corrupt=corrupt))
+        cut = int(len(ops) * 0.7)
+        cut -= cut % 2  # invoke/complete pairs stay aligned
+        p = tmp_path / "run.ckpt"
+        wgl.analysis_extend(model, ops[:cut], store_path=p, stride=64)
+        assert tckpt.read(p) is not None
+        scratch = wgl.analysis_extend(model, ops, stride=64,
+                                      certify=True)
+        resumed = wgl.analysis_extend(model, ops, store_path=p,
+                                      stride=64, certify=True)
+        assert resumed["valid?"] == scratch["valid?"]
+        assert cert_bytes(resumed) == cert_bytes(scratch)
+        certify.validate(History(ops), resumed["certificate"])
+        # and both agree with the plain reference analysis
+        plain = wgl.analysis(model, ops, certify=True)
+        assert resumed["valid?"] == plain["valid?"]
+        c = counters()
+        assert c.get("ckpt.extend.resumed", 0) >= 1
+        assert c.get("ckpt.extend.reused-masks", 0) >= 1
+
+    def test_stale_record_full_recheck(self, tmp_path):
+        """A checkpoint keyed to a DIFFERENT history costs a full
+        re-check (counted), never a wrong verdict."""
+        telemetry.reset()
+        model = models.cas_register()
+        ops = list(seeded_hist(21, 400))
+        p = tmp_path / "run.ckpt"
+        wgl.analysis_extend(model, list(seeded_hist(22, 400)),
+                            store_path=p, stride=64)
+        out = wgl.analysis_extend(model, ops, store_path=p, stride=64)
+        assert out["valid?"] == wgl.analysis(model, ops)["valid?"]
+        assert counters().get("ckpt.stale", 0) >= 1
+
+    def test_torn_record_full_recheck_then_replaced(self, tmp_path):
+        telemetry.reset()
+        model = models.cas_register()
+        ops = list(seeded_hist(23, 600))
+        p = tmp_path / "run.ckpt"
+        wgl.analysis_extend(model, ops[:400], store_path=p, stride=64)
+        prefix_rec = tckpt.read(p)
+        assert prefix_rec is not None
+        jchaos.corrupt_checkpoint(p, "torn")
+        out = wgl.analysis_extend(model, ops, store_path=p, stride=64)
+        assert out["valid?"] == wgl.analysis(model, ops)["valid?"]
+        assert counters().get("ckpt.torn", 0) >= 1
+        # the full re-check re-persisted a fresh, valid record that
+        # now covers the GROWN history's entry prefix
+        rec = tckpt.read(p)
+        assert rec is not None and rec["kind"] == "wgl-extend"
+        assert rec["n_ops"] > prefix_rec["n_ops"]
+        assert rec["digest"] == rec["digests"][-1]
+
+    def test_short_history_falls_through_to_plain(self, tmp_path):
+        telemetry.reset()
+        model = models.cas_register()
+        ops = list(seeded_hist(24, 30))
+        out = wgl.analysis_extend(model, ops,
+                                  store_path=tmp_path / "x.ckpt")
+        assert out["valid?"] == wgl.analysis(model, ops)["valid?"]
+        assert counters().get("ckpt.extend.fallback", 0) == 1
+
+
+# ---------------------------------------------------------------------------
+# WAL compaction: byte-identical replay, crash-safe at every instant
+# ---------------------------------------------------------------------------
+
+def build_wal(path, ops, chunk=40, fin=True):
+    from jepsen_tpu.fleet import wire
+
+    w = fwal.RunWAL(path)
+    w.append({"t": "hello", "tenant": "t", "run": "r",
+              "model": "cas-register", "weight": 1.0})
+    seq = 0
+    for i in range(0, len(ops), chunk):
+        seq += 1
+        w.append({"t": "chunk", "seq": seq,
+                  "ops": wire.ops_to_wire(ops[i:i + chunk])})
+    if fin:
+        w.append({"t": "fin", "n": len(ops)})
+    return w, seq
+
+
+def replayed_digest(path):
+    return tckpt.ops_digest(fwal.replay_ops(fwal.replay(path)))
+
+
+class TestWalCompaction:
+    def test_replay_byte_identical_across_compaction(self, tmp_path):
+        ops = list(seeded_hist(31, 400))
+        p = tmp_path / "r.wal"
+        w, last = build_wal(p, ops)
+        before = replayed_digest(p)
+        assert w.compact_through(3) is True
+        folded = fwal.replay(p)
+        assert folded["base"]["seq"] == 3
+        assert folded["last_seq"] == last
+        assert replayed_digest(p) == before
+        # compaction composes: a second fold through a later seq
+        assert w.compact_through(last) is True
+        assert replayed_digest(p) == before
+        w.close()
+
+    def test_appends_after_compaction_land(self, tmp_path):
+        from jepsen_tpu.fleet import wire
+
+        ops = list(seeded_hist(32, 400))
+        p = tmp_path / "r.wal"
+        w, last = build_wal(p, ops[:300], fin=False)
+        assert w.compact_through(last) is True
+        w.append({"t": "chunk", "seq": last + 1,
+                  "ops": wire.ops_to_wire(ops[300:])})
+        w.append({"t": "fin", "n": len(ops)})
+        w.close()
+        assert replayed_digest(p) == tckpt.ops_digest(ops)
+
+    def test_nothing_to_fold_is_a_noop(self, tmp_path):
+        ops = list(seeded_hist(33, 200))
+        p = tmp_path / "r.wal"
+        w, last = build_wal(p, ops)
+        raw = p.read_bytes()
+        assert w.compact_through(0) is False
+        assert w.compact_through(last + 7) is False  # beyond the tail
+        w.compact_through(2)
+        assert w.compact_through(1) is False  # at/below existing base
+        w.close()
+        assert fwal.compact(tmp_path / "absent.wal", 1) is False
+        # the no-op paths never rewrote the journal
+        w2, _ = build_wal(tmp_path / "r2.wal", ops)
+        w2.close()
+
+    def test_crash_mid_compaction_pre_file_wins(self, tmp_path):
+        """A crash BEFORE the atomic rename leaves a stray tmp and an
+        untouched journal: replay must serve the pre-compaction bytes
+        and a later compaction must still succeed."""
+        ops = list(seeded_hist(34, 300))
+        p = tmp_path / "r.wal"
+        w, last = build_wal(p, ops)
+        before = p.read_bytes()
+        # the torn artifact a SIGKILL mid-compaction leaves behind
+        p.with_suffix(".compact-tmp").write_bytes(
+            before[:len(before) // 2])
+        assert p.read_bytes() == before
+        assert replayed_digest(p) == tckpt.ops_digest(ops)
+        assert w.compact_through(last) is True
+        assert replayed_digest(p) == tckpt.ops_digest(ops)
+        w.close()
+
+
+# ---------------------------------------------------------------------------
+# streaming resume: StreamingRun / StreamingElle seed()
+# ---------------------------------------------------------------------------
+
+def wait_settled(stream, deadline_s=60):
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        with stream._lock:
+            busy = stream._inflight
+        if not busy:
+            return
+        time.sleep(0.02)
+    raise AssertionError("stream never settled")
+
+
+class TestStreamingRunResume:
+    def _drive(self, sched, ops, recs, seed_rec=None, name="r"):
+        sr = fsched.StreamingRun("cas-register", sched, "t", name)
+        sr.ckpt_sink = recs.append
+        if seed_rec is not None:
+            resumed = sr.seed(ops, seed_rec)
+            sr.step()
+            wait_settled(sr)
+            return sr, resumed
+        for i in range(0, len(ops), 100):
+            sr.add_ops(ops[i:i + 100])
+            wait_settled(sr)
+        sr.step()
+        wait_settled(sr)
+        return sr, False
+
+    def test_seed_resumes_checked_frontier(self, tmp_path):
+        telemetry.reset()
+        ops = list(seeded_hist(41, 700))
+        sched = fsched.Scheduler(window_s=0.01).start()
+        try:
+            recs = []
+            sr, _ = self._drive(sched, ops, recs)
+            assert recs, "no checkpoint record ever emitted"
+            rec = recs[-1]
+            tckpt.validate_record(rec)
+            assert rec["kind"] == "stream-wgl"
+            assert rec["digest"] == tckpt.ops_digest(ops,
+                                                     rec["n_ops"])
+            # a fresh stream seeded with that record resumes PAST the
+            # certified frontier instead of re-checking from entry 0
+            recs2 = []
+            sr2, resumed = self._drive(sched, ops, recs2,
+                                       seed_rec=rec, name="r2")
+            assert resumed is True
+            assert counters().get("ckpt.resumed", 0) == 1
+            assert sr.status()["state"] == "streaming"
+            assert sr2.status()["state"] == "streaming"
+            # the certified frontier was adopted, not re-derived
+            with sr2._lock:
+                assert sr2._checked >= rec["checked"]
+                assert sr2._mask is not None
+        finally:
+            sched.stop()
+
+    def test_seed_rejects_stale_record(self, tmp_path):
+        telemetry.reset()
+        ops = list(seeded_hist(42, 400))
+        rec = stream_wgl_rec(list(seeded_hist(43, 400)), checked=50,
+                             mask=3)
+        sched = fsched.Scheduler(window_s=0.01).start()
+        try:
+            sr = fsched.StreamingRun("cas-register", sched, "t", "r")
+            assert sr.seed(ops, rec) is False
+            assert counters().get("ckpt.stale", 0) == 1
+            # full fallback, not a wrong frontier
+            with sr._lock:
+                assert sr._checked == 0
+        finally:
+            sched.stop()
+
+
+def la_ops(*pairs):
+    """Sequential invoke/ok list-append txn pairs."""
+    out = []
+    for p, inv, okv in pairs:
+        out.append(make_op(index=len(out), time=len(out),
+                           type="invoke", process=p, f="txn",
+                           value=inv))
+        out.append(make_op(index=len(out), time=len(out), type="ok",
+                           process=p, f="txn", value=okv))
+    return out
+
+
+class TestStreamingElle:
+    def test_valid_stream_checkpoints_and_reseeds(self):
+        telemetry.reset()
+        ops = la_ops(
+            (0, [["append", "x", 1]], [["append", "x", 1]]),
+            (1, [["r", "x", None]], [["r", "x", [1]]]),
+            (0, [["append", "x", 2]], [["append", "x", 2]]),
+            (1, [["r", "x", None]], [["r", "x", [1, 2]]]))
+        se = telle.StreamingElle("list-append", "t", "r")
+        recs = []
+        se.ckpt_sink = recs.append
+        se.add_ops(ops)
+        se.step()
+        wait_settled(se)
+        assert se.status()["state"] == "streaming"
+        assert recs, "no elle checkpoint emitted"
+        rec = recs[-1]
+        tckpt.validate_record(rec)
+        assert rec["kind"] == "elle" and rec["n_closed"] == 4
+        se2 = telle.StreamingElle("list-append", "t", "r2")
+        assert se2.seed(ops, rec) is True
+        assert se2._n_closed == 4
+        # a record for a different stream is stale, never trusted
+        se3 = telle.StreamingElle("list-append", "t", "r3")
+        other = dict(rec, digest="0" * 64)
+        assert se3.seed(ops, other) is False
+        assert se3._n_closed == 0
+
+    def test_anomaly_tightens_to_tentative_invalid(self):
+        # G0: opposite append orders observed on x and y
+        ops = la_ops(
+            (0, [["append", "x", 1], ["append", "y", 1]],
+             [["append", "x", 1], ["append", "y", 1]]),
+            (1, [["append", "x", 2], ["append", "y", 2]],
+             [["append", "x", 2], ["append", "y", 2]]),
+            (2, [["r", "x", None], ["r", "y", None]],
+             [["r", "x", [1, 2]], ["r", "y", [2, 1]]]))
+        se = telle.StreamingElle("list-append", "t", "r")
+        se.add_ops(ops)
+        se.step()
+        wait_settled(se)
+        assert se.status()["state"] == "tentative-invalid"
+
+    def test_spine_reorder_reports_unknown(self):
+        """A longer read that rewrites an already-consumed version
+        order means earlier graph extensions are untrustworthy: the
+        stream stops tightening and says so."""
+        se = telle.StreamingElle("list-append", "t", "r")
+        se.add_ops(la_ops(
+            (0, [["append", "x", 1]], [["append", "x", 1]]),
+            (1, [["r", "x", None]], [["r", "x", [1]]])))
+        se.step()
+        wait_settled(se)
+        assert se.status()["state"] == "streaming"
+        se.add_ops(la_ops(
+            (0, [["append", "x", 2]], [["append", "x", 2]]),
+            (1, [["r", "x", None]], [["r", "x", [2, 1]]])))
+        se.step()
+        wait_settled(se)
+        assert se.status()["state"] == "unknown"
+
+    def test_other_families_degrade_honestly(self):
+        se = telle.StreamingElle("rw-register", "t", "r")
+        assert se.status()["state"] == "unsupported"
+        # seeding an unsupported stream never adopts a frontier
+        rec = {"v": tckpt.VERSION, "kind": "elle", "n_ops": 0,
+               "digest": "0" * 64, "family": "rw-register",
+               "n_closed": 0, "versions": {}, "frontier": {}}
+        assert se.seed([], rec) is False
+
+
+# ---------------------------------------------------------------------------
+# fleet e2e: SIGKILL mid-checkpoint-write, resume instead of replay
+# ---------------------------------------------------------------------------
+
+class TestFleetCheckpointE2E:
+    def test_sigkill_mid_ckpt_write_resumes_from_previous(
+            self, tmp_path):
+        """SIGKILL lands while a checkpoint write is in flight (a torn
+        tmp file survives next to the last good record): the restarted
+        server resumes the stream from the previous checkpoint — not
+        WAL-replay from seq 0 — and the final verdict and certificate
+        are byte-identical to an uninterrupted run's."""
+        h = seeded_hist(51, 1200)
+        ops = list(h)
+        chunks = [ops[i:i + 100] for i in range(0, len(ops), 100)]
+
+        ref_base = tmp_path / "ref"
+        srv = fserver.FleetServer(ref_base).start()
+        c = fclient.FleetClient(srv.addr, "t1", "r1", io_timeout_s=3)
+        for ch in chunks:
+            c.send_chunk(ch)
+        c.finish()
+        srv.stop()
+        ref = fwal.verdict_path(ref_base, "t1", "r1").read_bytes()
+
+        base = tmp_path / "crash"
+        srv = fserver.FleetServer(base).start()
+        c = fclient.FleetClient(srv.addr, "t1", "r1", io_timeout_s=2)
+        ckpt_path = tckpt.fleet_path(base, "t1", "r1")
+        wal_path = fwal.wal_path(base, "t1", "r1")
+        sent = 0
+        deadline = time.monotonic() + 60
+        for ch in chunks[:-2]:
+            c.send_chunk(ch)
+            sent += 1
+        while not ckpt_path.exists():
+            assert time.monotonic() < deadline, \
+                "stream never checkpointed"
+            time.sleep(0.05)
+        # ... and the WAL was compacted behind that checkpoint
+        while fwal.replay(wal_path)["base"] is None:
+            assert time.monotonic() < deadline, \
+                "WAL never compacted after checkpoint"
+            time.sleep(0.05)
+        good = ckpt_path.read_bytes()
+        port = srv.addr[1]
+        srv.kill()
+        # the torn artifact of a write interrupted by the SIGKILL
+        ckpt_path.with_suffix(".tmp").write_bytes(good[:9])
+        telemetry.reset()
+        srv2 = fserver.FleetServer(base, port=port).start()
+        # recovery seeded the stream from the checkpoint: the resume
+        # is O(suffix), counted — not a full re-check from entry 0
+        assert counters().get("ckpt.resumed", 0) == 1
+        assert counters().get("ckpt.stale", 0) == 0
+        for ch in chunks[len(chunks) - 2:]:
+            c.send_chunk(ch)
+        env = c.finish(timeout_s=120)
+        c.close()
+        assert env["result"]["valid?"] is True
+        got = fwal.verdict_path(base, "t1", "r1").read_bytes()
+        assert got == ref
+        srv2.stop()
+
+    def test_torn_checkpoint_on_restart_full_recheck(self, tmp_path):
+        """The checkpoint itself torn at restart: detected, discarded,
+        and the stream falls back to a full re-check — the verdict is
+        still byte-identical."""
+        h = seeded_hist(52, 1000)
+        ops = list(h)
+        chunks = [ops[i:i + 100] for i in range(0, len(ops), 100)]
+
+        ref_base = tmp_path / "ref"
+        srv = fserver.FleetServer(ref_base).start()
+        c = fclient.FleetClient(srv.addr, "t1", "r1", io_timeout_s=3)
+        for ch in chunks:
+            c.send_chunk(ch)
+        c.finish()
+        srv.stop()
+        ref = fwal.verdict_path(ref_base, "t1", "r1").read_bytes()
+
+        base = tmp_path / "crash"
+        srv = fserver.FleetServer(base).start()
+        c = fclient.FleetClient(srv.addr, "t1", "r1", io_timeout_s=2)
+        ckpt_path = tckpt.fleet_path(base, "t1", "r1")
+        deadline = time.monotonic() + 60
+        for ch in chunks[:-2]:
+            c.send_chunk(ch)
+        while not ckpt_path.exists():
+            assert time.monotonic() < deadline, \
+                "stream never checkpointed"
+            time.sleep(0.05)
+        port = srv.addr[1]
+        srv.kill()
+        jchaos.corrupt_checkpoint(ckpt_path, "torn")
+        telemetry.reset()
+        srv2 = fserver.FleetServer(base, port=port).start()
+        assert counters().get("ckpt.resumed", 0) == 0
+        assert counters().get("ckpt.torn", 0) >= 1
+        for ch in chunks[len(chunks) - 2:]:
+            c.send_chunk(ch)
+        env = c.finish(timeout_s=120)
+        c.close()
+        assert fwal.verdict_path(base, "t1", "r1").read_bytes() == ref
+        srv2.stop()

@@ -290,6 +290,9 @@ class TestScheduler:
 
     def test_breaker_opens_then_host_floor_still_correct(
             self, monkeypatch):
+        # SYSTEMIC failure: every run in the batch dies on device, so
+        # per-run attribution finds no survivor and the FLEET breaker
+        # (not per-run quarantine) takes the hit
         s = fsched.Scheduler()
         s._breaker.cooldown_s = 3600  # stay open for the test
         monkeypatch.setattr(
@@ -297,18 +300,24 @@ class TestScheduler:
             lambda *a, **k: (_ for _ in ()).throw(
                 RuntimeError("device dead")))
         hist = seeded_hist(2, 60)
-        for _ in range(fsched.BREAKER_THRESHOLD):
-            item = s.submit("final", "t", "r",
-                            {"engine": "wgl",
-                             "model": "cas-register",
-                             "history": hist})
+        for i in range(fsched.BREAKER_THRESHOLD):
+            items = [s.submit("final", "t", f"r{i}{j}",
+                              {"engine": "wgl",
+                               "model": "cas-register",
+                               "history": hist})
+                     for j in range(2)]
             with s._lock:
                 batch = s._drain_fair_locked()
             s._run_batch(batch)
+            for it in items:
+                assert it.result["valid?"] == "unknown"
         assert s._breaker.opened_at is not None
+        # systemic failure opens the breaker WITHOUT quarantining
+        # anyone — no single run was at fault
+        assert s.stats()["quarantine"] == []
         # breaker open: finals route to the pure-host search and the
         # verdict is still CORRECT (slower, never wrong)
-        item = s.submit("final", "t", "r2",
+        item = s.submit("final", "t", "rz",
                         {"engine": "wgl", "model": "cas-register",
                          "history": hist})
         with s._lock:
@@ -316,6 +325,50 @@ class TestScheduler:
         s._run_batch(batch)
         assert item.result["valid?"] is True
         assert s.stats()["host_floor"] == 1
+
+    def test_poison_run_quarantined_not_systemic(self, monkeypatch):
+        # ONE run's history kills the shared launch: attribution
+        # bisects along run boundaries, quarantines the offender to
+        # the solo host lane, and the fleet breaker stays CLOSED —
+        # healthy runs keep their device-batched verdicts
+        s = fsched.Scheduler()
+        poison = seeded_hist(3, 60)
+        real = wgl.analysis_batch_streamed
+
+        def selective(model, hists, **kw):
+            if any(h is poison for h in hists):
+                raise RuntimeError("device dead")
+            return real(model, hists, **kw)
+
+        monkeypatch.setattr(wgl, "analysis_batch_streamed", selective)
+        items = [s.submit("final", "t", f"r{j}",
+                          {"engine": "wgl", "model": "cas-register",
+                           "history": seeded_hist(10 + j, 60)})
+                 for j in range(2)]
+        bad = s.submit("final", "t", "rbad",
+                       {"engine": "wgl", "model": "cas-register",
+                        "history": poison})
+        with s._lock:
+            batch = s._drain_fair_locked()
+        s._run_batch(batch)
+        # healthy runs got their verdicts via solo-device retry
+        for it in items:
+            assert it.result["valid?"] is True
+        # the poison run still got a CORRECT verdict (host lane)
+        assert bad.result["valid?"] is True
+        st = s.stats()
+        assert [q["run"] for q in st["quarantine"]] == ["rbad"]
+        assert s._breaker.opened_at is None
+        # quarantined: the next final for that run skips the shared
+        # batch entirely and is served from the host lane
+        bad2 = s.submit("final", "t", "rbad",
+                        {"engine": "wgl", "model": "cas-register",
+                         "history": poison})
+        with s._lock:
+            batch = s._drain_fair_locked()
+        s._run_batch(batch)
+        assert bad2.result["valid?"] is True
+        assert s._breaker.opened_at is None
 
 
 # ---------------------------------------------------------------------------
@@ -956,6 +1009,65 @@ class TestFlightRecorderFleet:
             finally:
                 srv.stop()
 
+    def test_quarantine_events_in_recorder_and_metrics(
+            self, tmp_path, monkeypatch):
+        """Poison-run quarantine is observable end to end: the flight
+        recorder journals a schema-valid quarantine record, /metrics
+        exports quarantined_runs plus per-action event counters, and
+        the host-lane launch lands in the decision log under its own
+        "quarantine" reason — while a healthy neighbor keeps its
+        device-batched verdict."""
+        MARK = 888888  # wire round-trips rebuild ops, so a sentinel
+        poison = []    # value tags the poison history, not identity
+        for f, v in [("write", MARK), ("read", MARK)] * 10:
+            poison.append(make_op(
+                index=len(poison), time=len(poison), type="invoke",
+                process=0, f=f, value=v if f == "write" else None))
+            poison.append(make_op(
+                index=len(poison), time=len(poison), type="ok",
+                process=0, f=f, value=v))
+        real = wgl.analysis_batch_streamed
+
+        def selective(model, hists, **kw):
+            if any(any(o.f == "write" and o.value == MARK for o in h)
+                   for h in hists):
+                raise RuntimeError("injected poison launch death")
+            return real(model, hists, **kw)
+
+        monkeypatch.setattr(wgl, "analysis_batch_streamed", selective)
+        h = seeded_hist(61, 200)
+        srv = fserver.FleetServer(tmp_path / "fleet").start()
+        try:
+            cp = fclient.FleetClient(srv.addr, "tbad", "rbad",
+                                     model="cas-register")
+            cp.send_chunk(poison)
+            envp = cp.finish(timeout_s=120)
+            cp.close()
+            env = stream_run(srv.addr, "tgood", "r", h)
+            # host lane: slower, never wrong — and never starved
+            assert envp["result"]["valid?"] is True
+            assert_verdict_matches_solo(h, env["result"],
+                                        solo_verdict(h))
+            recs = srv.flightrec.records()
+            flightrec.validate_records(recs)
+            q = [r for r in recs if r["kind"] == "quarantine"]
+            assert [(r["tenant"], r["run"], r["action"])
+                    for r in q] == [("tbad", "rbad", "quarantined")]
+            stats = srv.stats()
+            assert [x["run"] for x
+                    in stats["scheduler"]["quarantine"]] == ["rbad"]
+            prom = fserver.prometheus_from_stats(stats)
+            assert flightrec.validate_prometheus(prom) > 0
+            assert "jepsen_fleet_quarantined_runs 1" in prom
+            assert ('jepsen_fleet_quarantine_events_total'
+                    '{action="quarantined"} 1') in prom
+            assert "jepsen_fleet_wal_sheds 0" in prom
+            fr = stats["flightrec"]
+            assert fr["quarantine"].get("quarantined") == 1
+            assert fr["decisions"].get("quarantine", 0) >= 1
+        finally:
+            srv.stop()
+
 
 # ---------------------------------------------------------------------------
 # interpreter hook (core.run integration)
@@ -1131,9 +1243,12 @@ class TestFleetLint:
         from jepsen_tpu.fleet import client as c
         from jepsen_tpu.fleet import scheduler as s
         from jepsen_tpu.fleet import server as srv
+        from jepsen_tpu.tpu import ckpt as ckpt_mod
+        from jepsen_tpu.tpu import elle as elle_mod
 
         fs = []
-        for mod in (s, srv, c, chaos_mod, flightrec):
+        for mod in (s, srv, c, chaos_mod, flightrec, ckpt_mod,
+                    elle_mod):
             fs.extend(concurrency.scan_module(mod))
         assert [(f.rule, f.kernel, f.site) for f in fs] == []
 
@@ -1144,6 +1259,8 @@ class TestFleetLint:
         assert "jepsen_tpu.fleet.scheduler" in names
         assert "jepsen_tpu.fleet.server" in names
         assert "jepsen_tpu.fleet.flightrec" in names
+        assert "jepsen_tpu.tpu.ckpt" in names
+        assert "jepsen_tpu.tpu.elle" in names
 
     def test_wgl_slices_registered_and_traces(self):
         from jepsen_tpu.analysis import registry

@@ -460,6 +460,21 @@ class TestPipeline:
         d = jstore.path(test)
         assert (d / "monitor.png").exists()
 
+    def test_monitor_graph_survives_zero_interval_samples(self, tmp_path):
+        """A run that finishes inside the sampler's first interval must
+        still render: the case→analyze boundary flush guarantees one
+        real-rate point (this was a load-dependent flake before)."""
+        test = _register_test(tmp_path, "mon-graph-slow",
+                              monitor_interval_s=99)
+        test["checker"] = jchecker.compose({
+            "stats": jchecker.stats(), "perf": jchecker.perf()})
+        test = core.run(test)
+        assert test["results"]["valid?"] is True
+        d = jstore.path(test)
+        pts = jstore.load_timeseries(d)
+        assert any(p.get("ops_s") is not None for p in pts)
+        assert (d / "monitor.png").exists()
+
     def test_interpreter_floor_with_monitor_enabled(self):
         """ISSUE-3 acceptance: the hot loop keeps its throughput with
         monitor + watchdog attached. The bound is RELATIVE to a bare

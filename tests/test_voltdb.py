@@ -1,0 +1,398 @@
+"""VoltDB suite tests: cluster bootstrap command emission via the
+dummy remote, an in-memory voltdb speaking the suite's sqlcmd batches,
+clusterless end-to-end register/dirty-read runs, and the suite's
+histories driven through the fleet under the durability-chaos rig
+(mirrors voltdb/src/jepsen/voltdb/*.clj; doc/robustness.md)."""
+
+import re
+import threading
+import time
+
+import pytest
+
+from jepsen_tpu import chaos as jchaos
+from jepsen_tpu import control, core, independent, testing
+from jepsen_tpu import generator as gen
+from jepsen_tpu.checker import models
+from jepsen_tpu.control.core import Action, Result
+from jepsen_tpu.control.dummy import DummyRemote
+from jepsen_tpu.fleet import client as fclient
+from jepsen_tpu.fleet import server as fserver
+from jepsen_tpu.history import op as make_op
+from jepsen_tpu.suites import voltdb as vdb
+from jepsen_tpu.tpu import certify, wgl
+
+
+def responder(node, action):
+    if action.cmd.startswith("stat "):
+        return Result(exit=1, out="", err="no such file",
+                      cmd=action.cmd)
+    if action.cmd.startswith("dirname "):
+        return action.cmd.split()[-1].rsplit("/", 1)[0]
+    if action.cmd.startswith("ls -A"):
+        return "voltdb-community-6.8"
+    return None
+
+
+def make_test(nodes=("n1", "n2", "n3")):
+    remote = DummyRemote(responder)
+    t = testing.noop_test()
+    t.update(nodes=list(nodes), remote=remote,
+             sessions={n: remote.connect({"host": n}) for n in nodes})
+    return core.prepare_test(t)
+
+
+def cmds(test, node):
+    return " ; ".join(a.cmd for a in test["sessions"][node].log
+                      if isinstance(a, Action))
+
+
+class TestDB:
+    def test_setup_creates_cluster_and_schema_once(self):
+        test = make_test()
+        db = vdb.VoltdbDB()
+        control.on_nodes(test, lambda t, n: db.setup(t, n))
+        got1, got2 = cmds(test, "n1"), cmds(test, "n2")
+        for got in (got1, got2):
+            assert "openjdk-8-jdk" in got
+            assert "voltdb-community-6.8.tar.gz" in got
+            assert "create --deployment /opt/voltdb/deployment.xml" \
+                in got
+            assert "--host n1" in got  # everyone meshes on primary
+            # 3 nodes tolerate a minority: kfactor 1
+            assert 'kfactor="1"' in got
+            assert 'synchronous="true"' in got  # command logging
+        # schema once, on the primary
+        assert "CREATE TABLE registers" in got1
+        assert "PARTITION TABLE registers" in got1
+        assert "CREATE TABLE" not in got2
+
+    def test_explicit_kfactor_wins(self):
+        test = make_test()
+        db = vdb.VoltdbDB(kfactor=2)
+        control.on_nodes(test, lambda t, n: db.setup(t, n))
+        assert 'kfactor="2"' in cmds(test, "n1")
+
+    def test_teardown_removes_state(self):
+        test = make_test()
+        db = vdb.VoltdbDB()
+        with control.with_session(test, "n2"):
+            db.teardown(test, "n2")
+        got = cmds(test, "n2")
+        assert "org.voltdb.VoltDB" in got
+        assert "rm -rf /opt/voltdb" in got
+
+    def test_restart_rejoins(self):
+        test = make_test()
+        db = vdb.VoltdbDB()
+        with control.with_session(test, "n2"):
+            db.start(test, "n2")
+        got = cmds(test, "n2")
+        assert "create --deployment" in got and "--host n1" in got
+
+
+# ---------------------------------------------------------------------------
+# in-memory voltdb
+# ---------------------------------------------------------------------------
+
+class FakeVolt:
+    """In-memory store executing the suite's sqlcmd batches atomically
+    — a perfectly linearizable 'voltdb'. DML answers with its
+    modified-tuple count like the real sqlcmd output."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.registers: dict = {}
+        self.dirty: set = set()
+
+    def run(self, sql: str) -> str:
+        with self.lock:
+            out = []
+            for stmt in filter(None,
+                               (s.strip() for s in sql.split(";"))):
+                line = self._stmt(stmt)
+                if line is not None:
+                    out.append(line)
+            return "\n".join(out)
+
+    def _stmt(self, s):
+        m = re.match(r"SELECT 'v=' \|\| CAST\(value AS VARCHAR\) "
+                     r"FROM registers WHERE id = (\d+)", s)
+        if m:
+            v = self.registers.get(int(m.group(1)))
+            return None if v is None else f"v={v}"
+        m = re.match(r"UPSERT INTO registers \(id, value\) VALUES "
+                     r"\((\d+), (-?\d+)\)", s)
+        if m:
+            self.registers[int(m.group(1))] = int(m.group(2))
+            return "1"
+        m = re.match(r"UPDATE registers SET value = (-?\d+) WHERE "
+                     r"id = (\d+) AND value = (-?\d+)", s)
+        if m:
+            new, k, old = (int(m.group(1)), int(m.group(2)),
+                           int(m.group(3)))
+            if self.registers.get(k) == old:
+                self.registers[k] = new
+                return "1"
+            return "0"
+        m = re.match(r"INSERT INTO dirty_reads \(id\) VALUES "
+                     r"\((\d+)\)", s)
+        if m:
+            self.dirty.add(int(m.group(1)))
+            return "1"
+        m = re.match(r"SELECT 'v=' \|\| CAST\(id AS VARCHAR\) FROM "
+                     r"dirty_reads WHERE id = (\d+)", s)
+        if m:
+            k = int(m.group(1))
+            return f"v={k}" if k in self.dirty else None
+        if s.startswith("SELECT 'i=' || CAST(id AS VARCHAR) "
+                        "FROM dirty_reads"):
+            return "\n".join(f"i={k}" for k in sorted(self.dirty))
+        raise AssertionError(f"fake voltdb can't parse: {s!r}")
+
+
+class FakeSqlFactory:
+    def __init__(self, state=None):
+        self.state = state or FakeVolt()
+
+    def __call__(self, test, node, timeout=10.0):
+        factory = self
+
+        class _S:
+            def run(self, sql):
+                return factory.state.run(sql)
+
+            def close(self):
+                pass
+
+        return _S()
+
+
+def run_register(opts, factory):
+    w = vdb.register_workload(opts)
+    w["client"].sql_factory = factory
+    test = testing.noop_test()
+    test.update(nodes=["n1", "n2"],
+                concurrency=opts.get("concurrency", 6),
+                client=w["client"], checker=w["checker"],
+                generator=gen.clients(
+                    gen.stagger(0.0004, w["generator"])))
+    return core.run(test)
+
+
+class TestEndToEnd:
+    def test_register_valid(self):
+        test = run_register({"concurrency": 6, "keys": 2,
+                             "ops_per_key": 60, "seed": 7},
+                            FakeSqlFactory())
+        assert test["results"]["valid?"] is True
+        fs = {op.f for op in test["history"]}
+        assert fs == {"read", "write", "cas"}
+
+    def test_phantom_read_detected(self):
+        """A value outside the 0..4 write domain returned on late
+        reads must fail the linearizable checker."""
+
+        class PhantomVolt(FakeVolt):
+            def __init__(self):
+                super().__init__()
+                self.reads = 0
+
+            def _stmt(self, s):
+                if s.startswith("SELECT 'v='") and "registers" in s:
+                    self.reads += 1
+                    if self.reads >= 20:
+                        return "v=99"
+                return super()._stmt(s)
+
+        test = run_register({"concurrency": 4, "keys": 1,
+                             "ops_per_key": 80, "seed": 3},
+                            FakeSqlFactory(PhantomVolt()))
+        assert test["results"]["valid?"] is False
+
+    def _run_dirty(self, factory, ops=120):
+        w = vdb.dirty_read_workload({"concurrency": 6, "ops": ops,
+                                     "seed": 5})
+        w["client"].sql_factory = factory
+        test = testing.noop_test()
+        test.update(nodes=["n1", "n2"], concurrency=6,
+                    client=w["client"], checker=w["checker"],
+                    generator=gen.phases(
+                        gen.clients(gen.stagger(
+                            0.0004, w["generator"])),
+                        gen.clients(w["final_generator"])))
+        return core.run(test)
+
+    def test_dirty_read_valid(self):
+        test = self._run_dirty(FakeSqlFactory())
+        res = test["results"]
+        assert res["valid?"] is True
+        assert res["strong-read-count"] > 0
+
+    def test_dirty_read_detected(self):
+        """An insert whose ack was lost but whose row leaked to
+        readers — and which no strong read contains — is the dirty
+        read the checker must flag."""
+
+        class LeakyVolt(FakeVolt):
+            def _stmt(self, s):
+                m = re.match(r"INSERT INTO dirty_reads \(id\) "
+                             r"VALUES \((\d+)\)", s)
+                if m:
+                    # visible to probes, never acked, and dropped
+                    # before the strong reads (an aborted txn's
+                    # uncommitted row)
+                    self.dirty.add(int(m.group(1)))
+                    return "0"
+                if s.startswith("SELECT 'i='"):
+                    return None  # strong reads: nothing committed
+                return super()._stmt(s)
+
+        test = self._run_dirty(FakeSqlFactory(LeakyVolt()))
+        res = test["results"]
+        assert res["valid?"] is False
+        assert res["dirty-count"] > 0
+
+
+class TestCli:
+    def test_registry_entry(self):
+        from jepsen_tpu import suites
+
+        assert suites.SUITES["voltdb"] == "jepsen_tpu.suites.voltdb"
+        assert suites.load("voltdb") is vdb
+
+    def test_test_map_shape(self):
+        opts = {"nodes": ["n1", "n2", "n3"], "concurrency": 6,
+                "ssh": {"dummy": True}, "time_limit": 5,
+                "workload": "register", "seed": 1}
+        test = vdb.voltdb_test(opts)
+        assert test["name"] == "voltdb-register"
+        assert isinstance(test["db"], vdb.VoltdbDB)
+
+    def test_dirty_read_final_phase_present(self):
+        opts = {"nodes": ["n1"], "concurrency": 4,
+                "ssh": {"dummy": True}, "workload": "dirty-read"}
+        test = vdb.voltdb_test(opts)
+        assert test["name"] == "voltdb-dirty-read"
+
+    def test_count_parser(self):
+        assert vdb._count("1\n") == 1
+        assert vdb._count("(Returned 1 rows)\n0\n") == 0
+        assert vdb._count("v=3\n") == 0
+
+
+# ---------------------------------------------------------------------------
+# the suite under the fleet's chaos/quarantine settings
+# ---------------------------------------------------------------------------
+
+def suite_register_history(seed=11, ops_per_key=80):
+    """A cas-register history produced by the SUITE's own workload
+    (key 0's subhistory, re-indexed) — the bridge from suite runs to
+    the fleet's streaming checkers."""
+    test = run_register({"concurrency": 6, "keys": 1,
+                         "ops_per_key": ops_per_key, "seed": seed},
+                        FakeSqlFactory())
+    ops = []
+    for o in test["history"]:
+        if o.f not in ("read", "write", "cas"):
+            continue
+        if independent.key_(o.value) != 0:
+            continue
+        ops.append(make_op(
+            index=len(ops), time=len(ops), type=o.type,
+            process=o.process, f=o.f,
+            value=independent.value_(o.value)))
+    return ops
+
+
+class TestUnderChaos:
+    def test_fleet_verdict_matches_solo_under_durability_chaos(
+            self, tmp_path):
+        """The suite's history streamed through the fleet while the
+        durability-chaos rig tears checkpoints and fails WAL writes:
+        the server sheds (never crashes), the run completes through
+        client retries, and the verdict matches the solo check. The
+        fleet breaker stays closed and nothing gets quarantined —
+        durability faults are not device failures."""
+        hist = suite_register_history()
+        solo = wgl.analysis(models.cas_register(), hist, certify=True)
+        srv = fserver.FleetServer(tmp_path / "fleet").start()
+        try:
+            with jchaos.DurabilityChaos(
+                    seed=9,
+                    wal_rates={"enospc": 0.25, "eio": 0.1},
+                    ckpt_rates={"torn-ckpt": 0.3, "eio": 0.2}):
+                c = fclient.FleetClient(srv.addr, "volt", "r0",
+                                        model="cas-register")
+                deadline = time.monotonic() + 120
+                i = 0
+                while i < len(hist):
+                    try:
+                        c.send_chunk(hist[i:i + 40])
+                        i += 40
+                    except fclient.FleetError:
+                        if time.monotonic() > deadline:
+                            raise
+                        time.sleep(0.1)
+                env = c.finish(timeout_s=60.0)
+                c.close()
+            result = env["result"]
+            assert result["valid?"] == solo["valid?"]
+            certify.validate(hist, result["certificate"])
+            st = srv.stats()
+            assert st["scheduler"]["quarantine"] == []
+            assert st["scheduler"]["breaker_open"] is False
+        finally:
+            srv.stop()
+
+    def test_poison_neighbor_cannot_starve_suite_run(
+            self, tmp_path, monkeypatch):
+        """The suite's run shares the fleet with a poison tenant whose
+        history kills every device launch it rides in: attribution
+        quarantines the poison run to the solo host lane, the voltdb
+        verdict is unaffected, and the fleet breaker stays closed."""
+        hist = suite_register_history(seed=13)
+        solo = wgl.analysis(models.cas_register(), hist, certify=True)
+        # the poison is marked by a sentinel value: wire round-trips
+        # rebuild ops server-side, so identity can't tag it
+        MARK = 777777
+        poison = []
+        for f, v in [("write", MARK), ("read", MARK)] * 10:
+            poison.append(make_op(
+                index=len(poison), time=len(poison), type="invoke",
+                process=0, f=f, value=v if f == "write" else None))
+            poison.append(make_op(
+                index=len(poison), time=len(poison), type="ok",
+                process=0, f=f, value=v))
+        real = wgl.analysis_batch_streamed
+
+        def selective(model, hists, **kw):
+            for h in hists:
+                if any(o.f == "write" and o.value == MARK
+                       for o in h):
+                    raise RuntimeError("injected poison launch death")
+            return real(model, hists, **kw)
+
+        monkeypatch.setattr(wgl, "analysis_batch_streamed", selective)
+        srv = fserver.FleetServer(tmp_path / "fleet").start()
+        try:
+            cp = fclient.FleetClient(srv.addr, "poison", "rbad",
+                                     model="cas-register")
+            cp.send_chunk(poison)
+            cv = fclient.FleetClient(srv.addr, "volt", "r1",
+                                     model="cas-register")
+            for i in range(0, len(hist), 40):
+                cv.send_chunk(hist[i:i + 40])
+            envp = cp.finish(timeout_s=120.0)
+            envv = cv.finish(timeout_s=120.0)
+            cp.close()
+            cv.close()
+            assert envv["result"]["valid?"] == solo["valid?"]
+            certify.validate(hist, envv["result"]["certificate"])
+            # the poison run still got a verdict — from the host lane
+            assert envp["result"]["valid?"] is True
+            st = srv.stats()["scheduler"]
+            assert [q["run"] for q in st["quarantine"]] == ["rbad"]
+            assert st["breaker_open"] is False
+        finally:
+            srv.stop()

@@ -119,7 +119,7 @@ class FakeYb:
     def _insert(self, s: str) -> str:
         m = re.search(r"INSERT INTO (\w+) \(([^)]*)\) VALUES "
                       r"\(([^)]*)\)(?:\s+ON CONFLICT \((\w+)\) DO "
-                      r"(NOTHING|UPDATE SET (\w+) = ('?[\w,]+'?)))?",
+                      r"(NOTHING|UPDATE SET (\w+) = (.+)))?",
                       s, re.I)
         if m is None:
             m2 = re.search(r"INSERT INTO (\w+) DEFAULT VALUES", s, re.I)
@@ -139,9 +139,14 @@ class FakeYb:
         if exists:
             if m.group(5) and m.group(5).upper() == "NOTHING":
                 return ""
-            if m.group(6):  # DO UPDATE SET col = v
-                self.tables[t][pk][m.group(6)] = self._coerce(
-                    m.group(7).strip("'"))
+            if m.group(6):  # DO UPDATE SET col = v | col = t.col || ',v'
+                col, expr = m.group(6), m.group(7).strip()
+                old = self.tables[t][pk]
+                cm = re.match(rf"{t}\.{col} \|\| ',?(\w+)'$", expr)
+                if cm:
+                    old[col] = f"{old[col]},{cm.group(1)}"
+                else:
+                    old[col] = self._coerce(expr.strip("'"))
                 return ""
             raise _SqlError(f"duplicate key {pk}")
         self.tables[t][pk] = row
